@@ -1,0 +1,32 @@
+"""beholder_tpu — a from-scratch rebuild of tritonmedia/beholder's capabilities.
+
+The reference (``/root/reference``, surveyed in ``SURVEY.md``) is a 160-line
+Node.js microservice that consumes two protobuf-encoded telemetry topics from
+RabbitMQ and fans the updates out to Postgres, Trello, Telegram, and Emby
+(``index.js:23-160``). It contains no ML code, no native components, and no
+parallelism (SURVEY.md §0) — so the honest rebuild is a service framework,
+not a model framework.
+
+This package provides:
+
+- ``config``    — config loading + service discovery (mirrors triton-core
+                  ``Config('events')`` / ``dyn()`` call sites, index.js:24,43)
+- ``proto``     — protobuf schemas reconstructed from field usage
+                  (index.js:64,131,142) plus load/decode/enum helpers
+- ``mq``        — message-queue abstraction: an in-memory broker for tests and
+                  an AMQP 0-9-1 wire client written from scratch (no AMQP
+                  client library exists in this image)
+- ``storage``   — the ``update_status``/``get_by_id`` store (index.js:68,76)
+- ``clients``   — Trello / Telegram / Emby side-effect clients
+                  (index.js:50-58,94-118)
+- ``metrics``   — the two Prometheus counters with identical names/labels
+                  (index.js:30-39) and an exposition endpoint
+- ``service``   — the bootstrap + both consumers with the reference's exact
+                  ack/error semantics (index.js:62-155)
+- ``ops`` / ``models`` / ``parallel`` — (in progress) a JAX/TPU
+  telemetry-analytics extension that goes BEYOND the reference (which has
+  no compute path); clearly documented as an addition, not attributed to
+  beholder.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""Side-effect clients: Trello, Telegram, Emby.
+
+Each mirrors one network boundary in the reference (SURVEY.md §3):
+Trello card moves/comments (index.js:50-58,79-90), Telegram deployment
+notifications (index.js:94-107), Emby library refresh (index.js:110-118).
+All share a pluggable HTTP transport so tests can intercept traffic.
+"""
+
+from .emby import EmbyClient
+from .http import HttpResponse, HttpTransport, RecordingTransport, RequestsTransport
+from .telegram import TelegramClient
+from .trello import TrelloClient
+
+__all__ = [
+    "HttpTransport",
+    "HttpResponse",
+    "RequestsTransport",
+    "RecordingTransport",
+    "TrelloClient",
+    "TelegramClient",
+    "EmbyClient",
+]

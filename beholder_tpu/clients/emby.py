@@ -1,0 +1,30 @@
+"""Emby media-server client.
+
+One operation: trigger a library refresh after a deployment
+(index.js:110-118).
+"""
+
+from __future__ import annotations
+
+from .http import HttpResponse, HttpTransport, RequestsTransport
+
+
+class EmbyClient:
+    def __init__(
+        self,
+        host: str,
+        token: str,
+        transport: HttpTransport | None = None,
+    ):
+        self._host = host.rstrip("/")
+        self._token = token
+        self._transport = transport or RequestsTransport()
+
+    def refresh_library(self) -> HttpResponse:
+        resp = self._transport.request(
+            "get",  # request-promise-native defaults to GET (index.js:112)
+            f"{self._host}/emby/library/refresh",
+            params={"api_key": self._token},
+        )
+        resp.raise_for_status()
+        return resp

@@ -1,0 +1,85 @@
+"""Pluggable HTTP transport.
+
+The reference talks to Trello through the ``trello`` npm package and to
+Telegram/Emby through raw ``request-promise-native`` calls (index.js:14,
+99-118). This rebuild routes all three through one transport interface so
+tests can assert on exact requests without network access.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class HttpResponse:
+    status: int
+    body: Any = None
+
+    def raise_for_status(self) -> None:
+        if self.status >= 400:
+            raise HttpError(self.status, self.body)
+
+
+class HttpError(RuntimeError):
+    def __init__(self, status: int, body: Any = None):
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.body = body
+
+
+class HttpTransport(abc.ABC):
+    @abc.abstractmethod
+    def request(
+        self,
+        method: str,
+        url: str,
+        *,
+        params: dict[str, Any] | None = None,
+        json: dict[str, Any] | None = None,
+        timeout: float = 10.0,
+    ) -> HttpResponse:
+        """Perform one HTTP request and return the (possibly JSON) response."""
+
+
+class RequestsTransport(HttpTransport):
+    """Production transport backed by ``requests``."""
+
+    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+        import requests
+
+        resp = requests.request(
+            method.upper(), url, params=params, json=json, timeout=timeout
+        )
+        try:
+            body = resp.json()
+        except ValueError:
+            body = resp.text
+        return HttpResponse(status=resp.status_code, body=body)
+
+
+@dataclass
+class _Recorded:
+    method: str
+    url: str
+    params: dict[str, Any] | None
+    json: dict[str, Any] | None
+
+
+class RecordingTransport(HttpTransport):
+    """Test transport: records every request, replies from a scripted queue."""
+
+    def __init__(self):
+        self.requests: list[_Recorded] = []
+        self.responses: list[HttpResponse] = []
+        self.fail_with: Exception | None = None
+
+    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+        self.requests.append(_Recorded(method.upper(), url, params, json))
+        if self.fail_with is not None:
+            raise self.fail_with
+        if self.responses:
+            return self.responses.pop(0)
+        return HttpResponse(status=200, body={})

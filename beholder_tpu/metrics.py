@@ -1,0 +1,143 @@
+"""Prometheus metrics with exact wire parity to the reference.
+
+The reference exposes two counters via prom-client (index.js:29-40):
+
+- ``beholder_progress_updates_total`` with label ``status``
+- ``beholder_trello_comments`` with no labels
+
+prom-client renders ``# TYPE <name> counter`` and the sample under the
+metric's exact name. python's ``prometheus_client`` force-appends ``_total``
+to counter names and emits extra ``_created`` series, which would break
+dashboards written against the reference's names — so this module implements
+the (tiny) classic text exposition format directly. Help strings are
+byte-identical to index.js:32,37 (including the reference's "crreated" typo).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable
+
+DEFAULT_PORT = 8000
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class Counter:
+    """A monotonically increasing counter, optionally labelled."""
+
+    def __init__(self, name: str, help: str, labelnames: Iterable[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._values: dict[tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        if not self.labelnames:
+            self._values[()] = 0.0
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
+            )
+        key = tuple(str(labels[name]) for name in self.labelnames)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(str(labels[name]) for name in self.labelnames)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, value in items:
+            if key:
+                labels = ",".join(
+                    f'{name}="{val}"' for name, val in zip(self.labelnames, key)
+                )
+                lines.append(f"{self.name}{{{labels}}} {_fmt(value)}")
+            else:
+                lines.append(f"{self.name} {_fmt(value)}")
+        return "\n".join(lines)
+
+
+def _fmt(value: float) -> str:
+    return str(int(value)) if value == int(value) else repr(value)
+
+
+class Registry:
+    def __init__(self):
+        self._counters: list[Counter] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str, labelnames: Iterable[str] = ()) -> Counter:
+        c = Counter(name, help, labelnames)
+        with self._lock:
+            if any(existing.name == name for existing in self._counters):
+                raise ValueError(f"duplicate metric {name!r}")
+            self._counters.append(c)
+        return c
+
+    def render(self) -> str:
+        with self._lock:
+            counters = list(self._counters)
+        return "\n".join(c.render() for c in counters) + "\n"
+
+
+class Metrics:
+    """The beholder metric set (``Prom.new('beholder')``, index.js:27-40)."""
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.progress_updates_total = self.registry.counter(
+            "beholder_progress_updates_total",
+            "Total number of messages processed in this processes lifetime",
+            labelnames=["status"],
+        )
+        self.trello_comments_total = self.registry.counter(
+            "beholder_trello_comments",
+            "Total trello comments crreated in this processes lifetime",
+        )
+        self._server: ThreadingHTTPServer | None = None
+
+    def expose(self, port: int | None = None) -> int:
+        """Start the /metrics endpoint (``Prom.expose()``, index.js:28).
+
+        Returns the bound port (pass 0 for an ephemeral one in tests).
+        """
+        if port is None:
+            port = int(os.environ.get("METRICS_PORT", DEFAULT_PORT))
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                payload = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args):  # quiet: structured logs only
+                pass
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        thread.start()
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

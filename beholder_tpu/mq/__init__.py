@@ -1,0 +1,19 @@
+"""Message-queue layer.
+
+The reference consumes RabbitMQ through triton-core's AMQP wrapper with a
+prefetch of 100 (/root/reference/index.js:43-44,62,127). This package
+provides the same contract behind a small broker interface:
+
+- :mod:`beholder_tpu.mq.base`   — ``Broker`` / ``Delivery`` interfaces with
+  explicit ack semantics (the reference acks even failed messages,
+  index.js:124,151,154 — at-most-once processing).
+- :mod:`beholder_tpu.mq.memory` — deterministic in-memory broker for tests
+  and benchmarks, with real prefetch accounting.
+- :mod:`beholder_tpu.mq.amqp`   — an AMQP 0-9-1 wire-protocol client written
+  from scratch (this image ships no AMQP client library).
+"""
+
+from .base import Broker, Delivery
+from .memory import InMemoryBroker
+
+__all__ = ["Broker", "Delivery", "InMemoryBroker"]

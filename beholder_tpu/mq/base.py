@@ -1,0 +1,81 @@
+"""Broker and delivery interfaces.
+
+Contract notes, all observable in the reference:
+
+- Handlers receive a delivery object and must explicitly ``ack()``
+  (index.js:124,151,154). The reference acks in every path, including error
+  paths — i.e. at-most-once processing, never requeue on failure.
+- Consumers are registered per topic via ``listen(topic, handler)``
+  (index.js:62,127). Topics are queue names ("v1.telemetry.status").
+- Prefetch bounds the number of unacked deliveries in flight
+  (100 in the reference, index.js:43).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable
+
+#: A consumer callback. Must call ``delivery.ack()`` (or ``nack``) itself.
+Handler = Callable[["Delivery"], None]
+
+
+class Delivery:
+    """One message handed to a consumer."""
+
+    __slots__ = ("topic", "body", "delivery_tag", "redelivered", "_settle")
+
+    def __init__(
+        self,
+        topic: str,
+        body: bytes,
+        delivery_tag: int,
+        settle: Callable[[int, bool, bool], None],
+        redelivered: bool = False,
+    ):
+        self.topic = topic
+        self.body = body
+        self.delivery_tag = delivery_tag
+        self.redelivered = redelivered
+        #: settle(delivery_tag, acked, requeue) — exactly-once per delivery.
+        self._settle = settle
+
+    def ack(self) -> None:
+        """Acknowledge; the broker may release a prefetch slot."""
+        self._settled_once(acked=True, requeue=False)
+
+    def nack(self, requeue: bool = True) -> None:
+        """Reject; optionally requeue for redelivery."""
+        self._settled_once(acked=False, requeue=requeue)
+
+    def _settled_once(self, acked: bool, requeue: bool) -> None:
+        settle, self._settle = self._settle, None
+        if settle is None:
+            raise RuntimeError(
+                f"delivery {self.delivery_tag} on {self.topic!r} already settled"
+            )
+        settle(self.delivery_tag, acked, requeue)
+
+    @property
+    def settled(self) -> bool:
+        return self._settle is None
+
+
+class Broker(abc.ABC):
+    """Minimal broker contract used by the service layer."""
+
+    @abc.abstractmethod
+    def connect(self) -> None:
+        """Establish the connection (index.js:44)."""
+
+    @abc.abstractmethod
+    def listen(self, topic: str, handler: Handler) -> None:
+        """Subscribe ``handler`` to ``topic`` (index.js:62,127)."""
+
+    @abc.abstractmethod
+    def publish(self, topic: str, body: bytes) -> None:
+        """Publish a message (producer side; used by tests/tools/bench)."""
+
+    @abc.abstractmethod
+    def close(self) -> None:
+        """Tear down the connection."""

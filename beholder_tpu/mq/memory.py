@@ -1,0 +1,108 @@
+"""Deterministic in-memory broker for tests and benchmarks.
+
+Implements the same observable semantics as the AMQP path: per-topic FIFO
+queues, a prefetch window bounding unacked deliveries, and
+requeue-on-nack redelivery (flagged ``redelivered``). Delivery is
+synchronous and single-threaded, which makes ack-semantics tests exact.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from beholder_tpu.log import get_logger
+
+from .base import Broker, Delivery, Handler
+
+
+@dataclass
+class _Topic:
+    handler: Handler | None = None
+    pending: deque = field(default_factory=deque)  # (body, redelivered)
+
+
+class InMemoryBroker(Broker):
+    def __init__(self, prefetch: int = 100):
+        self.prefetch = prefetch
+        self._topics: dict[str, _Topic] = {}
+        self._unacked: dict[int, tuple[str, bytes]] = {}
+        self._next_tag = 1
+        self._connected = False
+        self._dispatching = False
+        self._log = get_logger("mq.memory")
+
+    # -- Broker ------------------------------------------------------------
+    def connect(self) -> None:
+        self._connected = True
+
+    def close(self) -> None:
+        self._connected = False
+
+    def listen(self, topic: str, handler: Handler) -> None:
+        entry = self._topics.setdefault(topic, _Topic())
+        if entry.handler is not None:
+            raise ValueError(f"topic {topic!r} already has a consumer")
+        entry.handler = handler
+        self._dispatch()
+
+    def publish(self, topic: str, body: bytes) -> None:
+        self._topics.setdefault(topic, _Topic()).pending.append((bytes(body), False))
+        if self._connected:
+            self._dispatch()
+
+    # -- introspection for tests -------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        """Unacked deliveries currently held by consumers."""
+        return len(self._unacked)
+
+    def queue_depth(self, topic: str) -> int:
+        entry = self._topics.get(topic)
+        return len(entry.pending) if entry else 0
+
+    # -- internals ---------------------------------------------------------
+    def _dispatch(self) -> None:
+        """Deliver while prefetch slots and consumable messages remain."""
+        if self._dispatching or not self._connected:
+            return  # ack() inside a handler re-enters; the outer loop continues
+        self._dispatching = True
+        try:
+            progressed = True
+            while progressed and len(self._unacked) < self.prefetch:
+                progressed = False
+                # snapshot: a handler may publish to a brand-new topic,
+                # mutating self._topics mid-iteration
+                for topic, entry in list(self._topics.items()):
+                    if len(self._unacked) >= self.prefetch:
+                        break
+                    if entry.handler is None or not entry.pending:
+                        continue
+                    body, redelivered = entry.pending.popleft()
+                    tag = self._next_tag
+                    self._next_tag += 1
+                    self._unacked[tag] = (topic, body)
+                    delivery = Delivery(
+                        topic, body, tag, self._settle, redelivered=redelivered
+                    )
+                    progressed = True
+                    try:
+                        entry.handler(delivery)
+                    except Exception as err:  # noqa: BLE001
+                        # a throwing handler leaves its delivery unacked —
+                        # same outcome as an unhandled rejection in the
+                        # reference's consumer callbacks (SURVEY.md §3b)
+                        self._log.warning(
+                            f"handler for {topic!r} raised: {err!r}; "
+                            f"delivery {tag} left unacked"
+                        )
+        finally:
+            self._dispatching = False
+
+    def _settle(self, tag: int, acked: bool, requeue: bool) -> None:
+        topic, body = self._unacked.pop(tag)
+        if not acked and requeue:
+            self._topics[topic].pending.appendleft((body, True))
+        # a freed prefetch slot (or a requeue) may unblock pending work;
+        # re-entrant calls return immediately and the outer loop continues
+        self._dispatch()

@@ -1,0 +1,72 @@
+"""Protobuf registry — the triton-core/proto contract.
+
+Reproduces the four operations the reference uses
+(/root/reference/index.js:46-48,63,74,94,129,134,142):
+
+- ``load('api.TelemetryStatus')``  -> message class
+- ``decode(cls, bytes)``           -> message instance
+- ``enum_to_string(cls_or_name, 'TelemetryStatusEntry', value)`` -> name
+- ``string_to_enum(cls_or_name, 'TelemetryStatusEntry', name)``  -> value
+
+``enum_to_string``/``string_to_enum`` accept (and ignore) the message-class
+first argument the reference passes, because the enums here are package-level.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Type
+
+from google.protobuf.message import Message
+
+from . import api_pb2
+
+#: Full-name registry, mirroring proto.load('api.<Name>') (index.js:46-48).
+_MESSAGES: dict[str, Type[Message]] = {
+    "api.TelemetryStatus": api_pb2.TelemetryStatus,
+    "api.TelemetryProgress": api_pb2.TelemetryProgress,
+    "api.Media": api_pb2.Media,
+}
+
+_ENUMS = {
+    "TelemetryStatusEntry": api_pb2.TelemetryStatusEntry,
+    "CreatorType": api_pb2.CreatorType,
+}
+
+# Re-export the generated classes for direct use.
+TelemetryStatus = api_pb2.TelemetryStatus
+TelemetryProgress = api_pb2.TelemetryProgress
+Media = api_pb2.Media
+TelemetryStatusEntry = api_pb2.TelemetryStatusEntry
+CreatorType = api_pb2.CreatorType
+
+
+def load(full_name: str) -> Type[Message]:
+    """Look up a message class by full name, e.g. ``api.TelemetryStatus``."""
+    try:
+        return _MESSAGES[full_name]
+    except KeyError:
+        raise KeyError(
+            f"unknown message type {full_name!r}; known: {sorted(_MESSAGES)}"
+        ) from None
+
+
+def decode(message_cls: Type[Message], data: bytes) -> Message:
+    """Parse wire bytes into a message instance (index.js:63,129)."""
+    msg = message_cls()
+    msg.ParseFromString(data)
+    return msg
+
+
+def encode(msg: Message) -> bytes:
+    """Serialize a message (the producer side, for tests and tools)."""
+    return msg.SerializeToString()
+
+
+def enum_to_string(_scope: Any, enum_name: str, value: int) -> str:
+    """Enum value -> name, e.g. ``4 -> 'DEPLOYED'`` (index.js:74,134)."""
+    return _ENUMS[enum_name].Name(value)
+
+
+def string_to_enum(_scope: Any, enum_name: str, name: str) -> int:
+    """Enum name -> value, e.g. ``'TRELLO' -> 1`` (index.js:94,142)."""
+    return _ENUMS[enum_name].Value(name)

@@ -1,0 +1,224 @@
+"""The beholder service: bootstrap + the two telemetry consumers.
+
+Faithful rebuild of /root/reference/index.js:23-160. Observable semantics
+preserved exactly:
+
+- status consumer (index.js:62-125):
+  decode -> update DB -> early-ack if NO_TRELLO -> fetch row -> move Trello
+  card when creator is TRELLO and a flow list is mapped (pos=2) -> on
+  DEPLOYED, fire Telegram + Emby hooks with errors swallowed (warn only) ->
+  ack. Failures *before* the hook block (DB, Trello move) propagate and the
+  message is left unacked, exactly as an unhandled rejection leaves it in
+  the reference.
+- progress consumer (index.js:127-155):
+  entire body wrapped; any error warns and acks anyway — at-most-once.
+- comment helper increments beholder_trello_comments (index.js:50-58).
+"""
+
+from __future__ import annotations
+
+import time
+
+from beholder_tpu import proto
+from beholder_tpu.clients import (
+    EmbyClient,
+    HttpTransport,
+    TelegramClient,
+    TrelloClient,
+)
+from beholder_tpu.config import Config, ConfigNode, dyn, no_trello
+from beholder_tpu.log import get_logger
+from beholder_tpu.metrics import Metrics
+from beholder_tpu.mq import Broker, Delivery
+from beholder_tpu.storage import SqliteStorage, Storage
+
+STATUS_TOPIC = "v1.telemetry.status"
+PROGRESS_TOPIC = "v1.telemetry.progress"
+PREFETCH = 100  # index.js:43
+
+
+class BeholderService:
+    def __init__(
+        self,
+        config: ConfigNode,
+        broker: Broker,
+        db: Storage,
+        metrics: Metrics | None = None,
+        transport: HttpTransport | None = None,
+        logger=None,
+    ):
+        self.config = config
+        self.broker = broker
+        self.db = db
+        self.metrics = metrics or Metrics()
+        self.logger = logger or get_logger("beholder")
+
+        self.trello = TrelloClient(
+            config.get("keys.trello.key", ""),
+            config.get("keys.trello.token", ""),
+            transport=transport,
+        )
+        self.telegram = TelegramClient(
+            config.get("keys.telegram.token", ""), transport=transport
+        )
+        emby_host = config.get("instance.emby.host", "")
+        self.emby = EmbyClient(
+            emby_host, config.get("keys.emby.token", ""), transport=transport
+        )
+
+        #: status-name (lowercase) -> Trello list id (index.js:60)
+        self.flow_ids = config.get("instance.flow_ids") or ConfigNode({})
+
+        self._status_proto = proto.load("api.TelemetryStatus")
+        self._progress_proto = proto.load("api.TelemetryProgress")
+        proto.load("api.Media")  # parity with index.js:48
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Register both consumers (index.js:62,127) and log 'initialized'."""
+        self.broker.connect()
+        self.broker.listen(STATUS_TOPIC, self.handle_status)
+        self.broker.listen(PROGRESS_TOPIC, self.handle_progress)
+        self.logger.info("initialized")
+
+    # -- helpers -----------------------------------------------------------
+    def comment(self, card_id: str, text: str) -> None:
+        """Comment on a Trello card + count it (index.js:50-58)."""
+        self.logger.info(f"creating comment on {card_id} with text: {text}")
+        self.trello.comment_card(card_id, text)
+        self.metrics.trello_comments_total.inc()
+
+    # -- consumers ---------------------------------------------------------
+    def handle_status(self, delivery: Delivery) -> None:
+        """v1.telemetry.status (index.js:62-125)."""
+        msg = proto.decode(self._status_proto, delivery.body)
+        media_id, status = msg.mediaId, msg.status
+
+        self.logger.info(
+            f"processing status update for media {media_id}, status: {status}"
+        )
+
+        self.db.update_status(media_id, status)
+
+        if no_trello():
+            return delivery.ack()  # index.js:70-72
+
+        status_text = proto.enum_to_string(
+            self._status_proto, "TelemetryStatusEntry", status
+        )
+        media = self.db.get_by_id(media_id)
+
+        # Trello card movement (index.js:79-90)
+        if media.creator == 1:
+            list_pointer = self.flow_ids.get(status_text.lower())
+            if list_pointer:
+                self.logger.info(
+                    f"moving media card {media_id} (card id {media.creatorId})"
+                )
+                self.trello.move_card(media.creatorId, list_pointer, pos=2)
+            else:
+                self.logger.warning(
+                    f"unable to find list for status {status} ({status_text}) "
+                    f"avail ([{','.join(self.flow_ids)}])"
+                )
+
+        # deployed hooks — failures swallowed (index.js:92-122)
+        try:
+            deployed = proto.string_to_enum(
+                self._status_proto, "TelemetryStatusEntry", "DEPLOYED"
+            )
+            if media.status == deployed:
+                if self.config.get("instance.telegram.enabled"):
+                    self.logger.info(
+                        f"informing telegram that media '{media_id}' is available"
+                    )
+                    self.telegram.notify_deployed(
+                        self.config.get("instance.telegram.channel"),
+                        media.name,
+                        media.metadataId,
+                    )
+
+                if self.config.get("keys.emby.token") and self.config.get(
+                    "instance.emby.enabled"
+                ):
+                    self.logger.info(
+                        "telling emby to refresh at "
+                        f"{self.config.get('instance.emby.host')}"
+                    )
+                    self.emby.refresh_library()
+        except Exception as err:  # noqa: BLE001 - parity with index.js:120-122
+            self.logger.warning(f"failed to run deployed hooks: {err}")
+
+        delivery.ack()  # index.js:124
+
+    def handle_progress(self, delivery: Delivery) -> None:
+        """v1.telemetry.progress (index.js:127-155)."""
+        try:
+            msg = proto.decode(self._progress_proto, delivery.body)
+            media_id, status = msg.mediaId, msg.status
+            progress, host = msg.progress, msg.host
+
+            self.logger.info(
+                f"processing progress update on media {media_id} "
+                f"status {status} percent {progress}"
+            )
+            status_text = proto.enum_to_string(
+                self._progress_proto, "TelemetryStatusEntry", status
+            )
+
+            self.metrics.progress_updates_total.inc(status=status_text.lower())
+
+            media = self.db.get_by_id(media_id)
+
+            if media.creator == proto.string_to_enum(
+                proto.Media, "CreatorType", "TRELLO"
+            ):
+                comment_text = f"{status_text}: Progress **{progress}%**"
+                if host:
+                    comment_text += f" (_{host}_)"
+                self.comment(media.creatorId, comment_text)
+        except Exception as err:  # noqa: BLE001 - parity with index.js:149-152
+            self.logger.warning(f"failed to update media progress {err}")
+            return delivery.ack()
+
+        return delivery.ack()  # index.js:154
+
+
+def init(
+    config: ConfigNode | None = None,
+    broker: Broker | None = None,
+    db: Storage | None = None,
+    metrics_port: int | None = None,
+) -> BeholderService:
+    """Bootstrap, mirroring index.js:23-48 step for step."""
+    import os
+
+    config = config or Config.load("events")
+
+    metrics = Metrics()
+    metrics.expose(metrics_port)
+
+    db = db or SqliteStorage(os.environ.get("BEHOLDER_DB", "beholder.db"))
+
+    if broker is None:
+        try:
+            from beholder_tpu.mq.amqp import AmqpBroker
+        except ImportError as err:  # pragma: no cover
+            raise RuntimeError(
+                "the AMQP wire client is unavailable; pass an explicit "
+                "broker (e.g. InMemoryBroker) or fix the import"
+            ) from err
+        broker = AmqpBroker(dyn("rabbitmq"), prefetch=PREFETCH)
+
+    service = BeholderService(config, broker, db, metrics=metrics)
+    service.start()
+    return service
+
+
+def main() -> None:  # pragma: no cover - process entrypoint
+    service = init()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        service.broker.close()

@@ -1,0 +1,24 @@
+"""Media storage — the triton-core Storage contract.
+
+The reference calls exactly two methods (``db.updateStatus(mediaId, status)``
+at /root/reference/index.js:68 and ``db.getByID(mediaId)`` at
+index.js:76,140) against an external Postgres. Backends here:
+
+- :class:`MemoryStorage` — dict-backed, for tests.
+- :class:`SqliteStorage` — durable default (psycopg2 is not in this image;
+  a Postgres backend is gated behind :func:`postgres_storage`).
+
+Rows are surfaced as ``api.Media`` protobuf messages so handler attribute
+access (``media.creator``, ``media.creatorId``, ...) matches the reference.
+"""
+
+from .base import MediaNotFound, MemoryStorage, Storage, postgres_storage
+from .sqlite import SqliteStorage
+
+__all__ = [
+    "Storage",
+    "MemoryStorage",
+    "SqliteStorage",
+    "MediaNotFound",
+    "postgres_storage",
+]

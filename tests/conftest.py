@@ -1,0 +1,21 @@
+"""Test environment setup.
+
+Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
+so sharding/parallel tests exercise real multi-device code paths without TPU
+hardware. Must run at conftest import time (env vars are read once at backend
+init).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -1,0 +1,102 @@
+"""Trello/Telegram/Emby clients and the metrics exposition endpoint."""
+
+import urllib.request
+
+import pytest
+
+from beholder_tpu.clients import (
+    EmbyClient,
+    RecordingTransport,
+    TelegramClient,
+    TrelloClient,
+)
+from beholder_tpu.clients.http import HttpError, HttpResponse
+from beholder_tpu.metrics import Metrics
+
+
+def test_trello_move_card_shape():
+    t = RecordingTransport()
+    TrelloClient("K", "T", transport=t).move_card("card-9", "list-3")
+    (req,) = t.requests
+    assert req.method == "PUT"
+    assert req.url == "https://api.trello.com/1/cards/card-9"
+    # auth + body exactly as the npm client + index.js:83-86
+    assert req.params == {"key": "K", "token": "T", "idList": "list-3", "pos": 2}
+
+
+def test_trello_comment_shape_and_fallback_text():
+    t = RecordingTransport()
+    client = TrelloClient("K", "T", transport=t)
+    client.comment_card("c1", "QUEUED: Progress **5%**")
+    client.comment_card("c1", "")
+    first, second = t.requests
+    assert first.method == "POST"
+    assert first.url == "https://api.trello.com/1/cards/c1/actions/comments"
+    assert first.params["text"] == "QUEUED: Progress **5%**"
+    # empty text falls back exactly like index.js:54
+    assert second.params["text"] == "Failed to retrieve comment text."
+
+
+def test_trello_http_error_raises():
+    t = RecordingTransport()
+    t.responses.append(HttpResponse(status=401, body="no"))
+    with pytest.raises(HttpError):
+        TrelloClient("K", "T", transport=t).move_card("c", "l")
+
+
+def test_telegram_notify_deployed_message_shape():
+    t = RecordingTransport()
+    TelegramClient("TOK", transport=t).notify_deployed("@chan", "Bebop", "42")
+    (req,) = t.requests
+    assert req.url == "https://api.telegram.org/botTOK/sendMessage"
+    # message shape from index.js:103
+    assert req.params == {
+        "chat_id": "@chan",
+        "text": "*New Anime:* Bebop\nKitsu: https://kitsu.io/anime/42",
+        "parse_mode": "markdown",
+    }
+
+
+def test_emby_refresh_shape():
+    t = RecordingTransport()
+    EmbyClient("http://emby:8096/", "EK", transport=t).refresh_library()
+    (req,) = t.requests
+    assert req.url == "http://emby:8096/emby/library/refresh"
+    assert req.params == {"api_key": "EK"}
+
+
+def test_metrics_names_and_labels_match_reference():
+    m = Metrics()
+    m.progress_updates_total.inc(status="deployed")
+    m.progress_updates_total.inc(status="deployed")
+    m.trello_comments_total.inc()
+    text = m.registry.render()
+    # exact exposition parity with prom-client (index.js:30-39)
+    assert '# TYPE beholder_progress_updates_total counter' in text
+    assert 'beholder_progress_updates_total{status="deployed"} 2' in text
+    assert "# TYPE beholder_trello_comments counter" in text
+    assert "\nbeholder_trello_comments 1" in text
+    # no python-client artifacts
+    assert "_created" not in text
+    assert "beholder_trello_comments_total" not in text
+
+
+def test_metrics_endpoint_serves_http():
+    m = Metrics()
+    port = m.expose(port=0)
+    try:
+        m.progress_updates_total.inc(status="queued")
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        assert 'beholder_progress_updates_total{status="queued"} 1' in body
+    finally:
+        m.close()
+
+
+def test_counter_rejects_wrong_labels():
+    m = Metrics()
+    with pytest.raises(ValueError):
+        m.progress_updates_total.inc()
+    with pytest.raises(ValueError):
+        m.trello_comments_total.inc(status="x")

@@ -1,0 +1,110 @@
+"""Config loader, dyn() service discovery, and NO_TRELLO flag."""
+
+import json
+
+import pytest
+
+from beholder_tpu.config import Config, ConfigNode, dyn, no_trello
+
+
+@pytest.fixture()
+def events_config(tmp_path):
+    cfg = {
+        "keys": {
+            "trello": {"key": "k", "token": "t"},
+            "telegram": {"token": "tg"},
+            "emby": {"token": "em"},
+        },
+        "instance": {
+            "flow_ids": {"deployed": "list-deployed", "encoding": "list-enc"},
+            "telegram": {"enabled": True, "channel": "@c"},
+            "emby": {"enabled": True, "host": "http://emby:8096"},
+        },
+    }
+    path = tmp_path / "events.yaml"
+    import yaml
+
+    path.write_text(yaml.safe_dump(cfg))
+    return tmp_path
+
+
+def test_load_by_search_path(events_config):
+    config = Config.load("events", search_paths=[events_config])
+    # the reference's access patterns (index.js:25,60,100)
+    assert config.keys.trello.key == "k"
+    assert config.instance.flow_ids["deployed"] == "list-deployed"
+    assert config.keys.telegram.token == "tg"
+
+
+def test_load_by_env_var(events_config, monkeypatch):
+    monkeypatch.setenv("BEHOLDER_CONFIG", str(events_config / "events.yaml"))
+    config = Config.load("events", search_paths=[])
+    assert config.instance.telegram.enabled is True
+
+
+def test_missing_config_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        Config.load("events", search_paths=[tmp_path])
+
+
+def test_dotted_get_handles_missing_blocks():
+    # the reference guards optional blocks with truthiness (index.js:97,110)
+    config = ConfigNode({"instance": {}})
+    assert config.get("instance.telegram.enabled") is None
+    assert config.get("instance.telegram.enabled", False) is False
+    assert not config.get("instance.emby")
+
+
+def test_confignode_is_readonly():
+    node = ConfigNode({"a": 1})
+    with pytest.raises(AttributeError):
+        node.a = 2
+
+
+def test_keys_attribute_is_data_not_method():
+    # regression: 'keys' must reach the data, matching config.keys.* usage
+    node = ConfigNode({"keys": {"trello": {"key": "x"}}})
+    assert node.keys.trello.key == "x"
+
+
+def test_dyn_defaults_and_overrides(monkeypatch):
+    monkeypatch.delenv("RABBITMQ_URL", raising=False)
+    monkeypatch.delenv("RABBITMQ_HOST", raising=False)
+    monkeypatch.delenv("DNS_PREFIX", raising=False)
+    assert dyn("rabbitmq") == "amqp://127.0.0.1:5672"
+
+    monkeypatch.setenv("DNS_PREFIX", "triton.svc")
+    assert dyn("rabbitmq") == "amqp://rabbitmq.triton.svc:5672"
+
+    monkeypatch.setenv("RABBITMQ_HOST", "mq.internal")
+    assert dyn("rabbitmq") == "amqp://mq.internal:5672"
+
+    monkeypatch.setenv("RABBITMQ_URL", "amqp://user:pw@broker:5672/vhost")
+    assert dyn("rabbitmq") == "amqp://user:pw@broker:5672/vhost"
+
+
+def test_no_trello_flag(monkeypatch):
+    monkeypatch.delenv("NO_TRELLO", raising=False)
+    assert no_trello() is False
+    monkeypatch.setenv("NO_TRELLO", "1")
+    assert no_trello() is True
+
+
+def test_pino_log_shape(capsys):
+    from beholder_tpu.log import bind, get_logger
+
+    logger = get_logger("test-logger-shape")
+    bind(logger, mediaId="m1").info("processing status update")
+    line = capsys.readouterr().out.strip()
+    record = json.loads(line)
+    assert record["name"] == "test-logger-shape"
+    assert record["level"] == 30  # pino info
+    assert record["msg"] == "processing status update"
+    assert record["mediaId"] == "m1"
+    assert isinstance(record["time"], int)
+
+
+def test_explicit_config_override_fails_fast(monkeypatch, tmp_path):
+    monkeypatch.setenv("BEHOLDER_CONFIG", str(tmp_path / "missing.yaml"))
+    with pytest.raises(FileNotFoundError, match="BEHOLDER_CONFIG"):
+        Config.load("events", search_paths=[tmp_path])

@@ -1,0 +1,120 @@
+"""In-memory broker: prefetch, ack, nack/requeue semantics."""
+
+import pytest
+
+from beholder_tpu.mq import InMemoryBroker
+
+
+def test_delivers_to_listener():
+    broker = InMemoryBroker()
+    broker.connect()
+    seen = []
+    broker.listen("t", lambda d: (seen.append(d.body), d.ack()))
+    broker.publish("t", b"one")
+    broker.publish("t", b"two")
+    assert seen == [b"one", b"two"]
+    assert broker.in_flight == 0
+    assert broker.queue_depth("t") == 0
+
+
+def test_messages_published_before_listen_are_delivered():
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.publish("t", b"early")
+    seen = []
+    broker.listen("t", lambda d: (seen.append(d.body), d.ack()))
+    assert seen == [b"early"]
+
+
+def test_prefetch_bounds_unacked_deliveries():
+    broker = InMemoryBroker(prefetch=2)
+    broker.connect()
+    held = []
+    broker.listen("t", held.append)  # never acks
+    for i in range(5):
+        broker.publish("t", b"%d" % i)
+    assert len(held) == 2  # window full
+    assert broker.queue_depth("t") == 3
+
+    held[0].ack()  # releasing a slot pulls the next message
+    assert len(held) == 3
+    assert broker.in_flight == 2
+    assert broker.queue_depth("t") == 2
+
+
+def test_nack_requeues_with_redelivered_flag():
+    broker = InMemoryBroker()
+    broker.connect()
+    attempts = []
+
+    def handler(d):
+        attempts.append(d.redelivered)
+        if len(attempts) == 1:
+            d.nack(requeue=True)
+        else:
+            d.ack()
+
+    broker.listen("t", handler)
+    broker.publish("t", b"x")
+    assert attempts == [False, True]
+
+
+def test_nack_without_requeue_drops():
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.listen("t", lambda d: d.nack(requeue=False))
+    broker.publish("t", b"x")
+    assert broker.in_flight == 0
+    assert broker.queue_depth("t") == 0
+
+
+def test_double_settle_raises():
+    broker = InMemoryBroker()
+    broker.connect()
+    caught = []
+
+    def handler(d):
+        d.ack()
+        try:
+            d.ack()
+        except RuntimeError as e:
+            caught.append(e)
+
+    broker.listen("t", handler)
+    broker.publish("t", b"x")
+    assert len(caught) == 1
+
+
+def test_unacked_message_stays_in_flight():
+    # parity: a failed status handler leaves the message unacked (SURVEY §3b)
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.listen("t", lambda d: None)
+    broker.publish("t", b"x")
+    assert broker.in_flight == 1
+
+
+def test_duplicate_consumer_rejected():
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.listen("t", lambda d: d.ack())
+    with pytest.raises(ValueError):
+        broker.listen("t", lambda d: d.ack())
+
+
+def test_handler_publishing_to_new_topic_mid_dispatch():
+    # regression: a handler publishing to a never-seen topic must not
+    # corrupt the dispatch loop's iteration over topics
+    broker = InMemoryBroker()
+    broker.connect()
+    relayed = []
+
+    def relay(d):
+        broker.publish("t.out", b"relay:" + d.body)
+        d.ack()
+
+    broker.listen("t.in", relay)
+    broker.listen("t.out", lambda d: (relayed.append(d.body), d.ack()))
+    broker.publish("t.in", b"a")
+    broker.publish("t.in", b"b")
+    assert relayed == [b"relay:a", b"relay:b"]

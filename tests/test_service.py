@@ -1,0 +1,243 @@
+"""End-to-end service semantics — the subtle behaviors called out in
+SURVEY.md §7.4: ack-always on progress, NO_TRELLO early-ack, DEPLOYED-hook
+error swallowing, unacked-on-failure for the status path, and exact
+side-effect shapes.
+"""
+
+import pytest
+
+from beholder_tpu import proto
+from beholder_tpu.clients import RecordingTransport
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.mq import InMemoryBroker
+from beholder_tpu.service import PROGRESS_TOPIC, STATUS_TOPIC, BeholderService
+from beholder_tpu.storage import MemoryStorage
+
+S = proto.TelemetryStatusEntry
+
+
+def make_config(**overrides):
+    data = {
+        "keys": {
+            "trello": {"key": "K", "token": "T"},
+            "telegram": {"token": "TG"},
+            "emby": {"token": "EK"},
+        },
+        "instance": {
+            "flow_ids": {
+                "queued": "list-queued",
+                "downloading": "list-dl",
+                "deployed": "list-deployed",
+            },
+            "telegram": {"enabled": True, "channel": "@anime"},
+            "emby": {"enabled": True, "host": "http://emby:8096"},
+        },
+    }
+    data.update(overrides)
+    return ConfigNode(data)
+
+
+@pytest.fixture()
+def rig():
+    broker = InMemoryBroker(prefetch=100)
+    db = MemoryStorage()
+    transport = RecordingTransport()
+    service = BeholderService(make_config(), broker, db, transport=transport)
+    db.add_media(
+        proto.Media(
+            id="m1",
+            name="Bebop",
+            creator=proto.CreatorType.TRELLO,
+            creatorId="card-1",
+            metadataId="42",
+            status=S.QUEUED,
+        )
+    )
+    service.start()
+    return service, broker, db, transport
+
+
+def publish_status(broker, media_id="m1", status=S.DOWNLOADING):
+    broker.publish(
+        STATUS_TOPIC,
+        proto.encode(proto.TelemetryStatus(mediaId=media_id, status=status)),
+    )
+
+
+def publish_progress(broker, media_id="m1", status=S.DOWNLOADING, progress=42, host=""):
+    broker.publish(
+        PROGRESS_TOPIC,
+        proto.encode(
+            proto.TelemetryProgress(
+                mediaId=media_id, status=status, progress=progress, host=host
+            )
+        ),
+    )
+
+
+# -- status consumer -------------------------------------------------------
+
+
+def test_status_updates_db_and_moves_card(rig):
+    service, broker, db, transport = rig
+    publish_status(broker, status=S.DOWNLOADING)
+
+    assert db.get_by_id("m1").status == S.DOWNLOADING
+    (req,) = transport.requests
+    assert req.method == "PUT"
+    assert req.url.endswith("/1/cards/card-1")
+    assert req.params["idList"] == "list-dl"
+    assert req.params["pos"] == 2
+    assert broker.in_flight == 0  # acked
+
+
+def test_status_unmapped_list_warns_and_acks(rig):
+    service, broker, db, transport = rig
+    publish_status(broker, status=S.ERRORED)  # not in flow_ids
+    assert db.get_by_id("m1").status == S.ERRORED
+    assert transport.requests == []  # no Trello call (index.js:87-89)
+    assert broker.in_flight == 0
+
+
+def test_status_non_trello_creator_skips_move(rig):
+    service, broker, db, transport = rig
+    db.add_media(proto.Media(id="m2", creator=proto.CreatorType.API, status=S.QUEUED))
+    publish_status(broker, media_id="m2", status=S.DOWNLOADING)
+    assert transport.requests == []
+    assert broker.in_flight == 0
+
+
+def test_status_no_trello_env_acks_after_db_only(rig, monkeypatch):
+    service, broker, db, transport = rig
+    monkeypatch.setenv("NO_TRELLO", "1")
+    publish_status(broker, status=S.DEPLOYED)
+    assert db.get_by_id("m1").status == S.DEPLOYED
+    # early return: no trello, no telegram, no emby (index.js:70-72)
+    assert transport.requests == []
+    assert broker.in_flight == 0
+
+
+def test_status_deployed_fires_telegram_and_emby(rig):
+    service, broker, db, transport = rig
+    publish_status(broker, status=S.DEPLOYED)
+
+    urls = [r.url for r in transport.requests]
+    assert urls == [
+        "https://api.trello.com/1/cards/card-1",  # move to list-deployed
+        "https://api.telegram.org/botTG/sendMessage",
+        "http://emby:8096/emby/library/refresh",
+    ]
+    tg = transport.requests[1]
+    assert tg.params["chat_id"] == "@anime"
+    assert tg.params["text"] == "*New Anime:* Bebop\nKitsu: https://kitsu.io/anime/42"
+    assert tg.params["parse_mode"] == "markdown"
+    assert transport.requests[2].params == {"api_key": "EK"}
+    assert broker.in_flight == 0
+
+
+def test_status_deployed_hooks_disabled_by_config(rig):
+    broker = InMemoryBroker()
+    db = MemoryStorage()
+    transport = RecordingTransport()
+    config = make_config()
+    data = config.to_dict()
+    data["instance"] = {
+        "flow_ids": {"deployed": "list-deployed"},
+        "telegram": {"enabled": False},
+        # no emby block at all — the reference guards with && (index.js:110)
+    }
+    service = BeholderService(ConfigNode(data), broker, db, transport=transport)
+    db.add_media(
+        proto.Media(id="m1", creator=proto.CreatorType.TRELLO, creatorId="c1")
+    )
+    service.start()
+    publish_status(broker, status=S.DEPLOYED)
+    urls = [r.url for r in transport.requests]
+    assert urls == ["https://api.trello.com/1/cards/c1"]  # hooks skipped
+
+
+def test_status_deployed_hook_failure_swallowed_and_acked(rig):
+    service, broker, db, transport = rig
+    db.add_media(
+        # creator=API so the Trello move is skipped and only hooks run
+        proto.Media(id="m3", name="X", creator=proto.CreatorType.API, metadataId="7")
+    )
+    transport.fail_with = ConnectionError("telegram down")
+    publish_status(broker, media_id="m3", status=S.DEPLOYED)
+    # hook error swallowed (index.js:120-122); message still acked
+    assert broker.in_flight == 0
+    assert db.get_by_id("m3").status == S.DEPLOYED
+
+
+def test_status_db_failure_leaves_message_unacked(rig):
+    service, broker, db, transport = rig
+    publish_status(broker, media_id="unknown")
+    # update_status raised before any ack — parity with an unhandled
+    # rejection in the reference: the delivery is never settled
+    assert broker.in_flight == 1
+
+
+def test_status_trello_move_failure_leaves_message_unacked(rig):
+    from beholder_tpu.clients.http import HttpResponse
+
+    service, broker, db, transport = rig
+    transport.responses.append(HttpResponse(status=500, body="boom"))
+    publish_status(broker, status=S.DOWNLOADING)
+    assert broker.in_flight == 1  # failed before ack (index.js:83 throws)
+    # but the DB update DID land first
+    assert db.get_by_id("m1").status == S.DOWNLOADING
+
+
+# -- progress consumer ------------------------------------------------------
+
+
+def test_progress_comments_with_host(rig):
+    service, broker, db, transport = rig
+    publish_progress(broker, status=S.CONVERTING, progress=55, host="enc-1")
+    (req,) = transport.requests
+    assert req.url.endswith("/1/cards/card-1/actions/comments")
+    # exact format from index.js:143-146
+    assert req.params["text"] == "CONVERTING: Progress **55%** (_enc-1_)"
+    assert service.metrics.progress_updates_total.value(status="converting") == 1
+    assert service.metrics.trello_comments_total.value() == 1
+    assert broker.in_flight == 0
+
+
+def test_progress_comment_without_host(rig):
+    service, broker, db, transport = rig
+    publish_progress(broker, progress=10, host="")
+    (req,) = transport.requests
+    assert req.params["text"] == "DOWNLOADING: Progress **10%**"
+
+
+def test_progress_non_trello_creator_counts_but_no_comment(rig):
+    service, broker, db, transport = rig
+    db.add_media(proto.Media(id="m2", creator=proto.CreatorType.API))
+    publish_progress(broker, media_id="m2", status=S.UPLOADING)
+    assert transport.requests == []
+    assert service.metrics.progress_updates_total.value(status="uploading") == 1
+    assert broker.in_flight == 0
+
+
+def test_progress_error_is_swallowed_and_acked(rig):
+    service, broker, db, transport = rig
+    publish_progress(broker, media_id="unknown")  # get_by_id raises
+    # warn + ack anyway (index.js:149-152): at-most-once, never requeued
+    assert broker.in_flight == 0
+    # the counter increments before the failure point (index.js:136-140)
+    assert service.metrics.progress_updates_total.value(status="downloading") == 1
+
+
+def test_progress_comment_failure_still_acks(rig):
+    service, broker, db, transport = rig
+    transport.fail_with = ConnectionError("trello down")
+    publish_progress(broker)
+    assert broker.in_flight == 0
+    assert service.metrics.trello_comments_total.value() == 0
+
+
+def test_progress_undecodable_body_acked(rig):
+    service, broker, db, transport = rig
+    broker.publish(PROGRESS_TOPIC, b"\xff\xff\xff not a proto")
+    assert broker.in_flight == 0
+    assert transport.requests == []

@@ -1,0 +1,75 @@
+"""Storage backends: the update_status/get_by_id contract."""
+
+import pytest
+
+from beholder_tpu import proto
+from beholder_tpu.storage import (
+    MediaNotFound,
+    MemoryStorage,
+    SqliteStorage,
+    postgres_storage,
+)
+
+
+def _media(media_id="m1"):
+    return proto.Media(
+        id=media_id,
+        name="Cowboy Bebop",
+        creator=proto.CreatorType.TRELLO,
+        creatorId="card-1",
+        metadataId="1",
+        status=proto.TelemetryStatusEntry.QUEUED,
+    )
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def db(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryStorage()
+    else:
+        store = SqliteStorage(str(tmp_path / "test.db"))
+        yield store
+        store.close()
+
+
+def test_roundtrip(db):
+    db.add_media(_media())
+    row = db.get_by_id("m1")
+    assert row.name == "Cowboy Bebop"
+    assert row.creator == proto.CreatorType.TRELLO
+    assert row.creatorId == "card-1"
+
+
+def test_update_status(db):
+    db.add_media(_media())
+    db.update_status("m1", proto.TelemetryStatusEntry.DEPLOYED)
+    assert db.get_by_id("m1").status == proto.TelemetryStatusEntry.DEPLOYED
+
+
+def test_missing_row_raises(db):
+    with pytest.raises(MediaNotFound):
+        db.get_by_id("nope")
+    with pytest.raises(MediaNotFound):
+        db.update_status("nope", 1)
+
+
+def test_get_returns_copy(db):
+    db.add_media(_media())
+    row = db.get_by_id("m1")
+    row.status = proto.TelemetryStatusEntry.ERRORED
+    assert db.get_by_id("m1").status == proto.TelemetryStatusEntry.QUEUED
+
+
+def test_sqlite_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "p.db")
+    store = SqliteStorage(path)
+    store.add_media(_media())
+    store.close()
+    store2 = SqliteStorage(path)
+    assert store2.get_by_id("m1").name == "Cowboy Bebop"
+    store2.close()
+
+
+def test_postgres_gate_explains_itself():
+    with pytest.raises(RuntimeError, match="psycopg2"):
+        postgres_storage()
