@@ -13,7 +13,8 @@ provides the same contract behind a small broker interface:
   from scratch (this image ships no AMQP client library).
 """
 
+from .amqp import AmqpBroker
 from .base import Broker, Delivery
 from .memory import InMemoryBroker
 
-__all__ = ["Broker", "Delivery", "InMemoryBroker"]
+__all__ = ["Broker", "Delivery", "InMemoryBroker", "AmqpBroker"]

@@ -1,0 +1,287 @@
+"""AMQP 0-9-1 wire codec: frames, field types, and the method subset the
+beholder path needs.
+
+Written from the public AMQP 0-9-1 specification. No AMQP client library
+exists in this image, so both the client (:mod:`beholder_tpu.mq.amqp`) and
+the loopback test server (:mod:`beholder_tpu.mq.server`) are built on this
+module. The reference reaches RabbitMQ through the external triton-core
+wrapper over amqplib (/root/reference/index.js:18,43-44); this codec is the
+from-scratch equivalent of that transport layer.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Any
+
+PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
+FRAME_END = 0xCE
+
+# frame types
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+
+# class ids
+CLASS_CONNECTION = 10
+CLASS_CHANNEL = 20
+CLASS_QUEUE = 50
+CLASS_BASIC = 60
+
+# (class, method) ids
+CONNECTION_START = (10, 10)
+CONNECTION_START_OK = (10, 11)
+CONNECTION_TUNE = (10, 30)
+CONNECTION_TUNE_OK = (10, 31)
+CONNECTION_OPEN = (10, 40)
+CONNECTION_OPEN_OK = (10, 41)
+CONNECTION_CLOSE = (10, 50)
+CONNECTION_CLOSE_OK = (10, 51)
+CHANNEL_OPEN = (20, 10)
+CHANNEL_OPEN_OK = (20, 11)
+CHANNEL_CLOSE = (20, 40)
+CHANNEL_CLOSE_OK = (20, 41)
+QUEUE_DECLARE = (50, 10)
+QUEUE_DECLARE_OK = (50, 11)
+BASIC_QOS = (60, 10)
+BASIC_QOS_OK = (60, 11)
+BASIC_CONSUME = (60, 20)
+BASIC_CONSUME_OK = (60, 21)
+BASIC_PUBLISH = (60, 40)
+BASIC_DELIVER = (60, 60)
+BASIC_ACK = (60, 80)
+BASIC_NACK = (60, 120)
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# primitive encoders / decoders
+# --------------------------------------------------------------------------
+
+
+class Writer:
+    """Accumulates AMQP-encoded fields."""
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def octet(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">B", v))
+        return self
+
+    def short(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">H", v))
+        return self
+
+    def long(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">I", v))
+        return self
+
+    def longlong(self, v: int) -> "Writer":
+        self._parts.append(struct.pack(">Q", v))
+        return self
+
+    def shortstr(self, v: str) -> "Writer":
+        raw = v.encode("utf-8")
+        if len(raw) > 255:
+            raise ProtocolError("shortstr too long")
+        self._parts.append(struct.pack(">B", len(raw)) + raw)
+        return self
+
+    def longstr(self, v: bytes) -> "Writer":
+        self._parts.append(struct.pack(">I", len(v)) + v)
+        return self
+
+    def bits(self, *flags: bool) -> "Writer":
+        """Pack up to 8 bit flags into one octet (AMQP bit packing)."""
+        if len(flags) > 8:
+            raise ProtocolError("too many bits for one octet")
+        value = 0
+        for i, flag in enumerate(flags):
+            if flag:
+                value |= 1 << i
+        return self.octet(value)
+
+    def table(self, t: dict[str, Any]) -> "Writer":
+        body = Writer()
+        for key, value in t.items():
+            body.shortstr(key)
+            body._field_value(value)
+        payload = body.getvalue()
+        return self.longstr(payload)
+
+    def _field_value(self, value: Any) -> None:
+        if isinstance(value, bool):
+            self._parts.append(b"t" + struct.pack(">B", int(value)))
+        elif isinstance(value, int):
+            self._parts.append(b"I" + struct.pack(">i", value))
+        elif isinstance(value, str):
+            raw = value.encode("utf-8")
+            self._parts.append(b"S" + struct.pack(">I", len(raw)) + raw)
+        elif isinstance(value, bytes):
+            self._parts.append(b"S" + struct.pack(">I", len(value)) + value)
+        elif isinstance(value, dict):
+            self._parts.append(b"F")
+            self.table(value)
+        else:
+            raise ProtocolError(f"unsupported table value type {type(value)}")
+
+    def getvalue(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Reader:
+    """Sequential decoder over one frame payload."""
+
+    def __init__(self, data: bytes):
+        self._data = data
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise ProtocolError("truncated frame payload")
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def octet(self) -> int:
+        return self._take(1)[0]
+
+    def short(self) -> int:
+        return struct.unpack(">H", self._take(2))[0]
+
+    def long(self) -> int:
+        return struct.unpack(">I", self._take(4))[0]
+
+    def longlong(self) -> int:
+        return struct.unpack(">Q", self._take(8))[0]
+
+    def shortstr(self) -> str:
+        return self._take(self.octet()).decode("utf-8")
+
+    def longstr(self) -> bytes:
+        return self._take(self.long())
+
+    def table(self) -> dict[str, Any]:
+        payload = self.longstr()
+        sub = Reader(payload)
+        out: dict[str, Any] = {}
+        while sub._pos < len(sub._data):
+            # NB: assignment evaluates the RHS first, so the key must be
+            # read in its own statement
+            key = sub.shortstr()
+            out[key] = sub._field_value()
+        return out
+
+    def _field_value(self) -> Any:
+        kind = self._take(1)
+        if kind == b"t":
+            return bool(self.octet())
+        if kind == b"I":
+            return struct.unpack(">i", self._take(4))[0]
+        if kind == b"l":
+            return struct.unpack(">q", self._take(8))[0]
+        if kind == b"S":
+            return self.longstr().decode("utf-8", "replace")
+        if kind == b"F":
+            return self.table()
+        if kind == b"V":
+            return None
+        raise ProtocolError(f"unsupported field type {kind!r}")
+
+    @property
+    def remaining(self) -> int:
+        return len(self._data) - self._pos
+
+
+# --------------------------------------------------------------------------
+# frames
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Frame:
+    type: int
+    channel: int
+    payload: bytes
+
+    def serialize(self) -> bytes:
+        return (
+            struct.pack(">BHI", self.type, self.channel, len(self.payload))
+            + self.payload
+            + bytes([FRAME_END])
+        )
+
+
+def method_frame(channel: int, class_method: tuple[int, int], args: bytes = b"") -> Frame:
+    cid, mid = class_method
+    return Frame(FRAME_METHOD, channel, struct.pack(">HH", cid, mid) + args)
+
+
+#: basic-properties flag bit for delivery-mode (AMQP 0-9-1 §4.2.6.1)
+_FLAG_DELIVERY_MODE = 1 << 12
+DELIVERY_PERSISTENT = 2
+
+
+def header_frame(
+    channel: int, class_id: int, body_size: int, delivery_mode: int | None = None
+) -> Frame:
+    # weight=0; the only basic property the beholder path sets is
+    # delivery-mode=2 so messages survive a broker restart alongside the
+    # durable queues they sit in
+    flags = _FLAG_DELIVERY_MODE if delivery_mode is not None else 0
+    payload = struct.pack(">HHQH", class_id, 0, body_size, flags)
+    if delivery_mode is not None:
+        payload += struct.pack(">B", delivery_mode)
+    return Frame(FRAME_HEADER, channel, payload)
+
+
+def body_frames(channel: int, body: bytes, frame_max: int) -> list[Frame]:
+    # frame_max bounds the whole frame; 8 bytes overhead (7 header + 1 end)
+    chunk = max(1, frame_max - 8)
+    return [
+        Frame(FRAME_BODY, channel, body[i : i + chunk])
+        for i in range(0, len(body), chunk)
+    ]
+
+
+def heartbeat_frame() -> Frame:
+    return Frame(FRAME_HEARTBEAT, 0, b"")
+
+
+def parse_method(frame: Frame) -> tuple[tuple[int, int], Reader]:
+    reader = Reader(frame.payload)
+    cid = reader.short()
+    mid = reader.short()
+    return (cid, mid), reader
+
+
+class FrameParser:
+    """Incremental byte-stream -> frame parser."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> list[Frame]:
+        self._buf.extend(data)
+        frames = []
+        while True:
+            if len(self._buf) < 7:
+                break
+            ftype, channel, size = struct.unpack(">BHI", bytes(self._buf[:7]))
+            if len(self._buf) < 7 + size + 1:
+                break
+            payload = bytes(self._buf[7 : 7 + size])
+            if self._buf[7 + size] != FRAME_END:
+                raise ProtocolError(
+                    f"bad frame end 0x{self._buf[7 + size]:02x} "
+                    f"(type={ftype} channel={channel} size={size})"
+                )
+            del self._buf[: 7 + size + 1]
+            frames.append(Frame(ftype, channel, payload))
+        return frames

@@ -1,0 +1,372 @@
+"""A minimal in-process AMQP 0-9-1 broker server.
+
+Speaks the same wire protocol as RabbitMQ for the subset the beholder path
+uses (PLAIN auth, channel 1, queue.declare, basic.qos/consume/publish/
+deliver/ack/nack, heartbeats). Exists so the from-scratch client in
+:mod:`beholder_tpu.mq.amqp` can be tested end-to-end over a real TCP socket
+— handshake bytes, frame splitting, prefetch windows, redelivery on
+connection drop — without a RabbitMQ install. Also usable as a tiny dev
+broker (``python -m beholder_tpu.mq.server``).
+
+Semantics implemented (matching RabbitMQ's observable behavior):
+- per-queue FIFO with round-robin across consumers,
+- per-connection prefetch window (basic.qos),
+- unacked messages requeued (redelivered=1) when a connection drops,
+- basic.nack with requeue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from collections import deque
+
+from beholder_tpu.log import get_logger
+
+from . import codec
+
+
+class _Conn(asyncio.Protocol):
+    def __init__(self, server: "AmqpTestServer"):
+        self.server = server
+        self.parser = codec.FrameParser()
+        self.transport: asyncio.Transport | None = None
+        self.saw_header = False
+        self.prefetch = 0  # 0 = unlimited
+        self.unacked: dict[int, tuple[str, bytes]] = {}
+        self.consumes: dict[str, str] = {}  # queue -> consumer tag
+        self.next_tag = 1
+        # in-flight publish: [routing_key, expected_size, chunks]
+        self._pending: list | None = None
+        self._hb_task: asyncio.Task | None = None
+        self._log = server._log
+
+    # -- asyncio.Protocol ---------------------------------------------------
+    def connection_made(self, transport):
+        self.transport = transport
+        self.server.conns.add(self)
+
+    def connection_lost(self, exc):
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+        self.server.conns.discard(self)
+        # requeue unacked at the front, flagged redelivered (RabbitMQ behavior)
+        for _tag, (queue, body) in sorted(self.unacked.items(), reverse=True):
+            self.server.queues.setdefault(queue, deque()).appendleft((body, True))
+        self.unacked.clear()
+        for queue in self.consumes:
+            consumers = self.server.consumers.get(queue)
+            if consumers and self in consumers:
+                consumers.remove(self)
+        self.server.pump()
+
+    def data_received(self, data):
+        if not self.saw_header:
+            if len(data) < 8:
+                return  # pathological split; fine for a test server
+            header, data = data[:8], data[8:]
+            if header != codec.PROTOCOL_HEADER:
+                self.transport.close()
+                return
+            self.saw_header = True
+            self._send_start()
+        for frame in self.parser.feed(data):
+            self._on_frame(frame)
+
+    # -- helpers ------------------------------------------------------------
+    def _send(self, frame: codec.Frame) -> None:
+        if self.transport and not self.transport.is_closing():
+            self.transport.write(frame.serialize())
+
+    def _send_method(self, channel, cm, args: bytes = b"") -> None:
+        self._send(codec.method_frame(channel, cm, args))
+
+    def _send_start(self) -> None:
+        args = (
+            codec.Writer()
+            .octet(0)
+            .octet(9)
+            .table({"product": "beholder-tpu-testbroker"})
+            .longstr(b"PLAIN")
+            .longstr(b"en_US")
+            .getvalue()
+        )
+        self._send_method(0, codec.CONNECTION_START, args)
+
+    # -- frame handling -----------------------------------------------------
+    def _on_frame(self, frame: codec.Frame) -> None:
+        if frame.type == codec.FRAME_HEARTBEAT:
+            return
+        if frame.type == codec.FRAME_METHOD:
+            self._on_method(frame)
+        elif frame.type == codec.FRAME_HEADER and self._pending is not None:
+            reader = codec.Reader(frame.payload)
+            reader.short()
+            reader.short()
+            self._pending[1] = reader.longlong()
+            self._maybe_complete_publish()
+        elif frame.type == codec.FRAME_BODY and self._pending is not None:
+            self._pending[2].append(frame.payload)
+            self._maybe_complete_publish()
+
+    def _on_method(self, frame: codec.Frame) -> None:
+        cm, reader = codec.parse_method(frame)
+        if cm == codec.CONNECTION_START_OK:
+            reader.table()  # client properties
+            mechanism = reader.shortstr()
+            response = reader.longstr()
+            if mechanism != "PLAIN":
+                self.transport.close()
+                return
+            parts = response.split(b"\x00")
+            user = parts[1].decode() if len(parts) > 1 else ""
+            password = parts[2].decode() if len(parts) > 2 else ""
+            if (self.server.user, self.server.password) != (user, password):
+                self._log.warning(f"auth failed for user {user!r}")
+                # connection.close 403 access-refused, as RabbitMQ does
+                args = (
+                    codec.Writer()
+                    .short(403)
+                    .shortstr("ACCESS_REFUSED")
+                    .short(0)
+                    .short(0)
+                    .getvalue()
+                )
+                self._send_method(0, codec.CONNECTION_CLOSE, args)
+                return
+            tune = (
+                codec.Writer()
+                .short(2047)
+                .long(codec_frame_max())
+                .short(self.server.heartbeat)
+                .getvalue()
+            )
+            self._send_method(0, codec.CONNECTION_TUNE, tune)
+        elif cm == codec.CONNECTION_TUNE_OK:
+            pass
+        elif cm == codec.CONNECTION_OPEN:
+            self._send_method(0, codec.CONNECTION_OPEN_OK, codec.Writer().shortstr("").getvalue())
+            if self.server.send_heartbeats and self.server.heartbeat:
+                self._hb_task = asyncio.get_event_loop().create_task(
+                    self._heartbeats()
+                )
+        elif cm == codec.CONNECTION_CLOSE_OK:
+            self.transport.close()
+        elif cm == codec.CHANNEL_OPEN:
+            self._send_method(frame.channel, codec.CHANNEL_OPEN_OK, codec.Writer().longstr(b"").getvalue())
+        elif cm == codec.BASIC_QOS:
+            reader.long()  # prefetch size
+            self.prefetch = reader.short()
+            self._send_method(frame.channel, codec.BASIC_QOS_OK)
+        elif cm == codec.QUEUE_DECLARE:
+            reader.short()
+            queue = reader.shortstr()
+            self.server.queues.setdefault(queue, deque())
+            args = (
+                codec.Writer()
+                .shortstr(queue)
+                .long(len(self.server.queues[queue]))
+                .long(len(self.server.consumers.get(queue, [])))
+                .getvalue()
+            )
+            self._send_method(frame.channel, codec.QUEUE_DECLARE_OK, args)
+        elif cm == codec.BASIC_CONSUME:
+            reader.short()
+            queue = reader.shortstr()
+            tag = reader.shortstr() or f"ctag-{id(self)}"
+            self.consumes[queue] = tag
+            self.server.consumers.setdefault(queue, []).append(self)
+            self._send_method(
+                frame.channel, codec.BASIC_CONSUME_OK, codec.Writer().shortstr(tag).getvalue()
+            )
+            self.server.pump()
+        elif cm == codec.BASIC_PUBLISH:
+            reader.short()
+            reader.shortstr()  # exchange ("" = default)
+            routing_key = reader.shortstr()
+            self._pending = [routing_key, None, []]
+        elif cm == codec.BASIC_ACK:
+            tag = reader.longlong()
+            multiple = bool(reader.octet() & 1)
+            tags = (
+                [t for t in self.unacked if t <= tag] if multiple else [tag]
+            )
+            for t in tags:
+                self.unacked.pop(t, None)
+            self.server.pump()
+        elif cm == codec.BASIC_NACK:
+            tag = reader.longlong()
+            flags = reader.octet()
+            requeue = bool(flags & 2)
+            entry = self.unacked.pop(tag, None)
+            if entry is not None and requeue:
+                queue, body = entry
+                self.server.queues.setdefault(queue, deque()).appendleft((body, True))
+            self.server.pump()
+        elif cm == codec.CONNECTION_CLOSE:
+            self._send_method(0, codec.CONNECTION_CLOSE_OK)
+            self.transport.close()
+
+    async def _heartbeats(self) -> None:
+        hb = codec.heartbeat_frame()
+        try:
+            while True:
+                await asyncio.sleep(max(0.25, self.server.heartbeat / 2))
+                self._send(hb)
+        except asyncio.CancelledError:
+            pass
+
+    def _maybe_complete_publish(self) -> None:
+        pending = self._pending
+        if pending is None or pending[1] is None:
+            return
+        body = b"".join(pending[2])
+        if len(body) < pending[1]:
+            return
+        self._pending = None
+        self.server.queues.setdefault(pending[0], deque()).append((body, False))
+        self.server.pump()
+
+    # -- delivery -----------------------------------------------------------
+    def can_take(self) -> bool:
+        return self.prefetch == 0 or len(self.unacked) < self.prefetch
+
+    def deliver(self, queue: str, body: bytes, redelivered: bool) -> None:
+        tag = self.next_tag
+        self.next_tag += 1
+        self.unacked[tag] = (queue, body)
+        args = (
+            codec.Writer()
+            .shortstr(self.consumes[queue])
+            .longlong(tag)
+            .bits(redelivered)
+            .shortstr("")  # exchange
+            .shortstr(queue)  # routing key
+            .getvalue()
+        )
+        self._send_method(1, codec.BASIC_DELIVER, args)
+        self._send(codec.header_frame(1, codec.CLASS_BASIC, len(body)))
+        for bf in codec.body_frames(1, body, codec_frame_max()):
+            self._send(bf)
+
+
+def codec_frame_max() -> int:
+    return 131072
+
+
+class AmqpTestServer:
+    """In-process AMQP broker bound to 127.0.0.1 on an ephemeral port."""
+
+    def __init__(
+        self,
+        user: str = "guest",
+        password: str = "guest",
+        port: int = 0,
+        heartbeat: int = 30,
+        send_heartbeats: bool = True,
+    ):
+        self.user = user
+        self.password = password
+        self.heartbeat = heartbeat
+        #: set False to simulate a silently-dead broker (watchdog tests)
+        self.send_heartbeats = send_heartbeats
+        self._requested_port = port
+        self.queues: dict[str, deque] = {}
+        self.consumers: dict[str, list[_Conn]] = {}
+        self.conns: set[_Conn] = set()
+        self.port: int | None = None
+        self._log = get_logger("mq.server")
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._rr: dict[str, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        started = threading.Event()
+        self._thread = threading.Thread(target=self._run, args=(started,), daemon=True)
+        self._thread.start()
+        if not started.wait(5):
+            raise RuntimeError("test broker failed to start")
+        assert self.port is not None
+        return self.port
+
+    def _run(self, started: threading.Event) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _serve():
+            self._server = await self._loop.create_server(
+                lambda: _Conn(self), "127.0.0.1", self._requested_port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            started.set()
+
+        self._loop.run_until_complete(_serve())
+        self._loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+        loop = self._loop
+
+        def _shutdown():
+            for conn in list(self.conns):
+                if conn.transport:
+                    conn.transport.close()
+            if self._server is not None:
+                self._server.close()
+            loop.call_soon(loop.stop)
+
+        loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def drop_all_connections(self) -> None:
+        """Kill every client connection (for reconnect tests)."""
+        assert self._loop is not None
+        done = threading.Event()
+
+        def _drop():
+            for conn in list(self.conns):
+                if conn.transport:
+                    conn.transport.abort()
+            done.set()
+
+        self._loop.call_soon_threadsafe(_drop)
+        done.wait(5)
+
+    def queue_depth(self, queue: str) -> int:
+        return len(self.queues.get(queue, ()))
+
+    # -- scheduling ---------------------------------------------------------
+    def pump(self) -> None:
+        """Deliver queued messages to consumers with free prefetch slots."""
+        for queue, pending in self.queues.items():
+            consumers = [
+                c for c in self.consumers.get(queue, []) if c.can_take()
+            ]
+            while pending and consumers:
+                body, redelivered = pending.popleft()
+                idx = self._rr.get(queue, 0) % len(consumers)
+                self._rr[queue] = idx + 1
+                consumers[idx].deliver(queue, body, redelivered)
+                consumers = [c for c in consumers if c.can_take()]
+
+
+def main() -> None:  # pragma: no cover - dev tool
+    import os
+    import time
+
+    server = AmqpTestServer(port=int(os.environ.get("AMQP_PORT", "0")))
+    port = server.start()
+    print(f"amqp test broker listening on 127.0.0.1:{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

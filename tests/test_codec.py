@@ -1,0 +1,89 @@
+"""AMQP 0-9-1 codec: field encodings and incremental frame parsing."""
+
+import pytest
+
+from beholder_tpu.mq import codec
+
+
+def test_primitive_roundtrip():
+    w = (
+        codec.Writer()
+        .octet(7)
+        .short(513)
+        .long(70000)
+        .longlong(1 << 40)
+        .shortstr("v1.telemetry.status")
+        .longstr(b"payload-bytes")
+    )
+    r = codec.Reader(w.getvalue())
+    assert r.octet() == 7
+    assert r.short() == 513
+    assert r.long() == 70000
+    assert r.longlong() == 1 << 40
+    assert r.shortstr() == "v1.telemetry.status"
+    assert r.longstr() == b"payload-bytes"
+    assert r.remaining == 0
+
+
+def test_table_roundtrip():
+    table = {
+        "product": "beholder-tpu",
+        "count": 42,
+        "flag": True,
+        "nested": {"a": "b"},
+    }
+    data = codec.Writer().table(table).getvalue()
+    assert codec.Reader(data).table() == table
+
+
+def test_bits_packing():
+    # durable=True in position 1 of queue.declare bit packing
+    data = codec.Writer().bits(False, True, False, False, False).getvalue()
+    assert data == bytes([0b00010])
+
+
+def test_shortstr_too_long_rejected():
+    with pytest.raises(codec.ProtocolError):
+        codec.Writer().shortstr("x" * 256)
+
+
+def test_frame_serialize_parse_roundtrip():
+    frame = codec.method_frame(1, codec.BASIC_ACK, b"\x00" * 9)
+    parser = codec.FrameParser()
+    (parsed,) = parser.feed(frame.serialize())
+    assert parsed.type == codec.FRAME_METHOD
+    assert parsed.channel == 1
+    cm, _ = codec.parse_method(parsed)
+    assert cm == codec.BASIC_ACK
+
+
+def test_parser_handles_byte_by_byte_feeding():
+    frames = (
+        codec.method_frame(0, codec.CONNECTION_TUNE_OK, b"\x00\x01" * 4).serialize()
+        + codec.heartbeat_frame().serialize()
+    )
+    parser = codec.FrameParser()
+    out = []
+    for i in range(len(frames)):
+        out.extend(parser.feed(frames[i : i + 1]))
+    assert [f.type for f in out] == [codec.FRAME_METHOD, codec.FRAME_HEARTBEAT]
+
+
+def test_parser_rejects_bad_frame_end():
+    frame = bytearray(codec.heartbeat_frame().serialize())
+    frame[-1] = 0x00
+    with pytest.raises(codec.ProtocolError):
+        codec.FrameParser().feed(bytes(frame))
+
+
+def test_body_frames_split_by_frame_max():
+    body = b"x" * 1000
+    frames = codec.body_frames(1, body, frame_max=108)  # 100-byte chunks
+    assert len(frames) == 10
+    assert b"".join(f.payload for f in frames) == body
+    assert all(len(f.payload) <= 100 for f in frames)
+
+
+def test_truncated_payload_raises():
+    with pytest.raises(codec.ProtocolError):
+        codec.Reader(b"\x01").short()
