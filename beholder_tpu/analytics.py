@@ -1,0 +1,107 @@
+"""Optional batch-analytics sink for the progress stream.
+
+EXTENSION BEYOND THE REFERENCE. When ``instance.analytics.enabled`` is set,
+the progress consumer records each observation into this sink; every
+``flush_every`` observations the buffered batch is aggregated on the
+accelerator (one fused XLA program — see beholder_tpu.ops) and the summary
+is logged as a structured record, giving operators fleet-wide per-status
+counts and progress statistics without a metrics query.
+
+JAX is imported lazily so the core service path starts fast and runs on
+hosts with no accelerator stack configured.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from beholder_tpu.log import get_logger
+
+
+class AnalyticsSink:
+    """Buffers observations; aggregates full batches on the accelerator.
+
+    ``async_flush=True`` (what the service uses) hands the batch to a
+    single background worker thread so XLA compilation and device compute
+    never stall the message-consumer hot path (prefetch would fill and
+    telemetry processing would freeze otherwise). Synchronous mode is for
+    direct/library use and tests.
+    """
+
+    def __init__(self, flush_every: int = 4096, logger=None, async_flush: bool = False):
+        if flush_every <= 0:
+            raise ValueError("flush_every must be positive")
+        self.flush_every = flush_every
+        self._statuses: list[int] = []
+        self._progress: list[int] = []
+        self._log = logger or get_logger("analytics")
+        self._executor = None
+        if async_flush:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="analytics"
+            )
+
+    def record(self, status: int, progress: int) -> dict[str, Any] | None:
+        """Buffer one observation; flush when the batch is full.
+
+        Returns the flushed summary when a synchronous flush happened,
+        else None (async flushes log their summary from the worker).
+        """
+        self._statuses.append(int(status))
+        self._progress.append(int(progress))
+        if len(self._statuses) >= self.flush_every:
+            return self.flush()
+        return None
+
+    @property
+    def buffered(self) -> int:
+        return len(self._statuses)
+
+    def flush(self) -> dict[str, Any] | None:
+        """Aggregate the buffer (inline, or on the worker in async mode)."""
+        if not self._statuses:
+            return None
+        batch_s, self._statuses = self._statuses, []
+        batch_p, self._progress = self._progress, []
+        if self._executor is not None:
+            self._executor.submit(self._aggregate_safe, batch_s, batch_p)
+            return None
+        return self._aggregate(batch_s, batch_p)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until pending async flushes complete (shutdown/tests)."""
+        if self._executor is not None:
+            # the worker is single-threaded, so a sentinel task completing
+            # means everything submitted before it has finished
+            self._executor.submit(lambda: None).result(timeout=timeout)
+
+    def _aggregate_safe(self, statuses: list[int], progress: list[int]) -> None:
+        try:
+            self._aggregate(statuses, progress)
+        except Exception as err:  # noqa: BLE001 - worker must not die silently
+            self._log.warning(f"analytics aggregation failed: {err!r}")
+
+    def _aggregate(
+        self, statuses: list[int], progress: list[int]
+    ) -> dict[str, Any]:
+        import jax.numpy as jnp
+
+        from beholder_tpu.ops import aggregate_telemetry
+        from beholder_tpu.proto import TelemetryStatusEntry
+
+        out = aggregate_telemetry(jnp.asarray(statuses), jnp.asarray(progress))
+        summary = {
+            TelemetryStatusEntry.Name(s).lower(): {
+                "count": int(out["count"][s]),
+                "mean_progress": round(float(out["mean_progress"][s]), 2),
+                "max_progress": float(out["max_progress"][s]),
+            }
+            for s in range(len(TelemetryStatusEntry.keys()))
+            if int(out["count"][s]) > 0
+        }
+        self._log.info(
+            "telemetry aggregate", extra={"fields": {"aggregate": summary}}
+        )
+        return summary

@@ -1,0 +1,23 @@
+"""Models for telemetry analytics.
+
+EXTENSION BEYOND THE REFERENCE (which contains no models — SURVEY.md §0).
+The flagship is :class:`~beholder_tpu.models.anomaly.ProgressAnomalyModel`,
+a next-step progress predictor whose prediction error flags stalled or
+misbehaving encode jobs from their progress streams.
+"""
+
+from .anomaly import (
+    ProgressAnomalyModel,
+    anomaly_scores,
+    init_train_state,
+    make_windows,
+    train_step,
+)
+
+__all__ = [
+    "ProgressAnomalyModel",
+    "make_windows",
+    "init_train_state",
+    "train_step",
+    "anomaly_scores",
+]

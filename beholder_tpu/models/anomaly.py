@@ -1,0 +1,110 @@
+"""Progress-stream anomaly model.
+
+A small MLP that predicts the next progress value of an encode job from a
+window of recent (progress delta, status) observations; the absolute
+prediction error is the anomaly score. Stalls (delta collapses to 0 while
+status says CONVERTING) and jumps (progress regressions after retries)
+surface as high error without hand-written thresholds.
+
+TPU-first design choices:
+- fixed window size -> static shapes; batch is the only leading dim
+- bfloat16 matmuls with float32 params/accumulation (MXU-native mix)
+- pure-functional train step (params in, params out) so it jits and
+  shards with pjit/GSPMD (see beholder_tpu.parallel)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from beholder_tpu.ops import NUM_STATUSES
+
+WINDOW = 16  # observations per window
+FEATURES = 1 + NUM_STATUSES  # progress delta + one-hot status
+HIDDEN = 128
+
+
+class ProgressAnomalyModel(nn.Module):
+    """MLP over flattened windows: (B, WINDOW*FEATURES) -> (B,) next delta."""
+
+    hidden: int = HIDDEN
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(jnp.bfloat16)
+        x = nn.Dense(self.hidden, name="in_proj")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden, name="mid_proj")(x)
+        x = nn.relu(x)
+        x = nn.Dense(1, name="out_proj", dtype=jnp.float32)(x)
+        return x[..., 0].astype(jnp.float32)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_windows(
+    progress: jax.Array, statuses: jax.Array, window: int = WINDOW
+) -> tuple[jax.Array, jax.Array]:
+    """Slice a telemetry stream into model inputs.
+
+    Args:
+        progress: (T,) progress values of one media job, time-ordered.
+        statuses: (T,) status ids aligned with ``progress``.
+
+    Returns:
+        features: (T-window-1, window*FEATURES) flattened windows of
+            (progress delta, one-hot status).
+        targets: (T-window-1,) the delta immediately after each window.
+    """
+    deltas = jnp.diff(progress.astype(jnp.float32))  # (T-1,)
+    status_oh = jax.nn.one_hot(statuses[1:], NUM_STATUSES)  # aligned w/ deltas
+    feats = jnp.concatenate([deltas[:, None], status_oh], axis=-1)  # (T-1, F)
+
+    n = deltas.shape[0] - window
+    idx = jnp.arange(n)[:, None] + jnp.arange(window)[None, :]  # (n, window)
+    windows = feats[idx].reshape(n, window * FEATURES)
+    targets = deltas[window:]
+    return windows, targets
+
+
+def init_train_state(
+    rng: jax.Array, learning_rate: float = 1e-3, window: int = WINDOW
+) -> tuple[TrainState, optax.GradientTransformation]:
+    model = ProgressAnomalyModel()
+    params = model.init(rng, jnp.zeros((1, window * FEATURES)))
+    tx = optax.adam(learning_rate)
+    return TrainState(params, tx.init(params), jnp.int32(0)), tx
+
+
+def loss_fn(params: Any, windows: jax.Array, targets: jax.Array) -> jax.Array:
+    pred = ProgressAnomalyModel().apply(params, windows)
+    return jnp.mean((pred - targets) ** 2)
+
+
+def train_step(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    windows: jax.Array,
+    targets: jax.Array,
+) -> tuple[TrainState, jax.Array]:
+    """One SGD step. Pure function — jit/pjit it at the call site so the
+    same code serves single-chip and sharded execution."""
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, windows, targets)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss
+
+
+def anomaly_scores(params: Any, windows: jax.Array, targets: jax.Array) -> jax.Array:
+    """|predicted next delta - actual| per window; higher = more anomalous."""
+    pred = ProgressAnomalyModel().apply(params, windows)
+    return jnp.abs(pred - targets)

@@ -1,0 +1,15 @@
+"""JAX telemetry-aggregation ops.
+
+EXTENSION BEYOND THE REFERENCE: tritonmedia/beholder has no compute path of
+any kind (SURVEY.md §0 — it processes one message at a time on a JS event
+loop). This package adds a batch analytics layer for high-volume telemetry:
+given arrays of status/progress observations, it computes per-status counts,
+progress statistics, and EWMA rates as single fused XLA programs, so an
+operator can aggregate millions of buffered telemetry events on a TPU chip
+instead of row-by-row in Python. Nothing here is attributed to the
+reference; parity components live in the sibling packages.
+"""
+
+from .aggregate import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
+
+__all__ = ["NUM_STATUSES", "aggregate_telemetry", "status_counts", "ewma"]

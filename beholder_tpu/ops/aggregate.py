@@ -1,0 +1,92 @@
+"""Batched telemetry aggregation as fused XLA programs.
+
+Design notes (TPU-first):
+- The per-status reductions are phrased as a one-hot matmul so XLA lowers
+  them onto the MXU for large batches instead of scatter-adds.
+- Everything is fixed-shape and jittable; batch size is the only traced
+  dimension, so one compilation serves a given buffer size.
+- dtypes: accumulation in float32 (progress is 0-100, so bfloat16 inputs
+  are safe and halve HBM traffic; sums stay exact in f32).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from beholder_tpu.proto import TelemetryStatusEntry
+
+#: Size of the status enum (QUEUED..ERRORED, proto/api.proto).
+NUM_STATUSES = len(TelemetryStatusEntry.keys())
+
+
+@partial(jax.jit, static_argnames=("num_statuses",))
+def status_counts(statuses: jax.Array, num_statuses: int = NUM_STATUSES) -> jax.Array:
+    """Count observations per status. (B,) int -> (S,) int32.
+
+    Counts accumulate in int32 (exact for any batch size that fits in
+    memory); a float32 accumulator would silently lose exactness past
+    2^24 observations of one status.
+    """
+    one_hot = jax.nn.one_hot(statuses, num_statuses, dtype=jnp.int32)
+    return one_hot.sum(axis=0)
+
+
+@partial(jax.jit, static_argnames=("num_statuses",))
+def aggregate_telemetry(
+    statuses: jax.Array,
+    progress: jax.Array,
+    num_statuses: int = NUM_STATUSES,
+) -> dict[str, jax.Array]:
+    """Per-status counts + progress statistics in one fused program.
+
+    Args:
+        statuses: (B,) int status ids.
+        progress: (B,) progress percentages (any float/int dtype).
+
+    Returns dict of (S,)-shaped arrays: ``count``, ``mean_progress``,
+    ``max_progress``, ``min_progress`` (min/max are 0 where count==0).
+    """
+    one_hot = jax.nn.one_hot(statuses, num_statuses, dtype=jnp.float32)  # (B,S)
+    progress = progress.astype(jnp.float32)
+
+    # counts in int32 for exactness (f32 drifts past 2^24 events/status);
+    # progress sums stay f32 (values <= 100, relative error ~1e-7 — fine
+    # for a mean even at hundred-million-event batches)
+    counts_i = one_hot.astype(jnp.int32).sum(axis=0)  # (S,)
+    counts = counts_i.astype(jnp.float32)
+    sums = one_hot.T @ progress  # (S,) — MXU-friendly contraction
+    mean = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), 0.0)
+
+    big = jnp.float32(1e9)
+    per_status = jnp.where(one_hot > 0, progress[:, None], -big)
+    maxes = jnp.where(counts > 0, per_status.max(axis=0), 0.0)
+    per_status_min = jnp.where(one_hot > 0, progress[:, None], big)
+    mins = jnp.where(counts > 0, per_status_min.min(axis=0), 0.0)
+
+    return {
+        "count": counts_i,
+        "mean_progress": mean,
+        "max_progress": maxes,
+        "min_progress": mins,
+    }
+
+
+@jax.jit
+def ewma(series: jax.Array, alpha: float | jax.Array = 0.1) -> jax.Array:
+    """Exponentially weighted moving average over a time series.
+
+    Sequential dependence is expressed with ``lax.scan`` (compiler-friendly
+    control flow — no Python loop under jit).
+    """
+    alpha = jnp.asarray(alpha, dtype=jnp.float32)
+    series = series.astype(jnp.float32)
+
+    def step(carry, x):
+        carry = alpha * x + (1.0 - alpha) * carry
+        return carry, carry
+
+    _, out = jax.lax.scan(step, series[0], series)
+    return out

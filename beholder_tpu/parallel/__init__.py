@@ -1,0 +1,24 @@
+"""Mesh + sharding helpers for the analytics extension.
+
+EXTENSION BEYOND THE REFERENCE (which has no parallelism of any kind —
+SURVEY.md §2 lists every strategy as absent). Scaling here follows the
+idiomatic JAX recipe: build a ``jax.sharding.Mesh``, annotate array
+shardings with ``NamedSharding``/``PartitionSpec``, jit the pure train
+step, and let GSPMD insert the collectives.
+"""
+
+from .mesh import (
+    batch_sharding,
+    make_mesh,
+    param_shardings,
+    replicated,
+    sharded_train_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "param_shardings",
+    "replicated",
+    "sharded_train_step",
+]

@@ -69,6 +69,17 @@ class BeholderService:
         #: status-name (lowercase) -> Trello list id (index.js:60)
         self.flow_ids = config.get("instance.flow_ids") or ConfigNode({})
 
+        #: optional batch-analytics extension (not part of reference parity)
+        self.analytics = None
+        if config.get("instance.analytics.enabled"):
+            from beholder_tpu.analytics import AnalyticsSink
+
+            self.analytics = AnalyticsSink(
+                flush_every=int(config.get("instance.analytics.flush_every", 4096)),
+                logger=self.logger,
+                async_flush=True,  # XLA work must not stall the consumer
+            )
+
         self._status_proto = proto.load("api.TelemetryStatus")
         self._progress_proto = proto.load("api.TelemetryProgress")
         proto.load("api.Media")  # parity with index.js:48
@@ -167,6 +178,18 @@ class BeholderService:
             )
 
             self.metrics.progress_updates_total.inc(status=status_text.lower())
+
+            if self.analytics is not None:
+                try:
+                    self.analytics.record(status, progress)
+                except Exception as err:  # noqa: BLE001
+                    # the extension must never break the parity path: on any
+                    # sink failure (e.g. broken accelerator stack), disable
+                    # analytics and keep consuming
+                    self.logger.warning(
+                        f"analytics sink failed ({err!r}); disabling analytics"
+                    )
+                    self.analytics = None
 
             media = self.db.get_by_id(media_id)
 

@@ -1,0 +1,170 @@
+"""Benchmark: end-to-end telemetry message throughput.
+
+Drives the complete consumer path — protobuf decode, DB update/fetch,
+metric increments, Trello comment formatting + (nulled) HTTP side effect,
+ack — for a 50/50 mix of status and progress messages, exactly the two hot
+loops of the reference (SURVEY.md §3b/§3c).
+
+The reference publishes NO benchmark numbers (BASELINE.md: "published: {}",
+metric "N/A"), so there is no reference value to normalize against;
+``vs_baseline`` is reported as 1.0 by convention with the explanation in
+``note``. A secondary figure reports the analytics extension's batched
+aggregation throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from beholder_tpu import proto
+from beholder_tpu.clients.http import HttpResponse, HttpTransport
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.mq import InMemoryBroker
+from beholder_tpu.service import PROGRESS_TOPIC, STATUS_TOPIC, BeholderService
+from beholder_tpu.storage import MemoryStorage
+
+N_MEDIA = 64
+N_MESSAGES = 60_000
+WARMUP = 2_000
+
+
+class NullTransport(HttpTransport):
+    """Formats/serializes like the real path but skips the socket."""
+
+    def __init__(self):
+        self.count = 0
+
+    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+        self.count += 1
+        return HttpResponse(status=200, body={})
+
+
+def build_service() -> tuple[BeholderService, InMemoryBroker, NullTransport]:
+    import logging
+
+    # stdout must carry exactly one JSON line; per-message INFO logs go to
+    # the bit bucket (their formatting cost is excluded from the measurement,
+    # matching how the reference's pino pipes logs out-of-process)
+    quiet = logging.getLogger("bench.quiet")
+    quiet.addHandler(logging.NullHandler())
+    quiet.propagate = False
+    quiet.setLevel(logging.CRITICAL)
+
+    broker = InMemoryBroker(prefetch=100)
+    db = MemoryStorage()
+    transport = NullTransport()
+    config = ConfigNode(
+        {
+            "keys": {"trello": {"key": "K", "token": "T"}},
+            "instance": {
+                "flow_ids": {
+                    "queued": "l0",
+                    "downloading": "l1",
+                    "converting": "l2",
+                    "uploading": "l3",
+                    "deployed": "l4",
+                }
+            },
+        }
+    )
+    for i in range(N_MEDIA):
+        db.add_media(
+            proto.Media(
+                id=f"m{i}",
+                name=f"Media {i}",
+                creator=proto.CreatorType.TRELLO,
+                creatorId=f"card-{i}",
+                metadataId=str(i),
+            )
+        )
+    service = BeholderService(config, broker, db, transport=transport, logger=quiet)
+    service.start()
+    return service, broker, transport
+
+
+def make_messages(n: int) -> list[tuple[str, bytes]]:
+    msgs = []
+    statuses = list(range(4))  # stay off DEPLOYED to keep the mix steady
+    for i in range(n):
+        media_id = f"m{i % N_MEDIA}"
+        st = statuses[i % len(statuses)]
+        if i % 2 == 0:
+            body = proto.encode(proto.TelemetryStatus(mediaId=media_id, status=st))
+            msgs.append((STATUS_TOPIC, body))
+        else:
+            body = proto.encode(
+                proto.TelemetryProgress(
+                    mediaId=media_id, status=st, progress=i % 101, host="enc"
+                )
+            )
+            msgs.append((PROGRESS_TOPIC, body))
+    return msgs
+
+
+def bench_service() -> float:
+    service, broker, transport = build_service()
+    for topic, body in make_messages(WARMUP):
+        broker.publish(topic, body)
+    msgs = make_messages(N_MESSAGES)
+    start = time.perf_counter()
+    for topic, body in msgs:
+        broker.publish(topic, body)
+    elapsed = time.perf_counter() - start
+    assert broker.in_flight == 0, "benchmark messages must all be acked"
+    assert transport.count > 0
+    return N_MESSAGES / elapsed
+
+
+def bench_aggregation() -> dict:
+    """Secondary: batched telemetry aggregation on the accelerator."""
+    import jax
+    import numpy as np
+
+    from beholder_tpu.ops import aggregate_telemetry
+
+    batch = 1_000_000
+    rng = np.random.default_rng(0)
+    statuses = jax.device_put(rng.integers(0, 6, size=batch))
+    progress = jax.device_put(rng.integers(0, 101, size=batch))
+
+    out = aggregate_telemetry(statuses, progress)  # compile + warm
+    jax.block_until_ready(out)
+    reps = 20
+    start = time.perf_counter()
+    for _ in range(reps):
+        out = aggregate_telemetry(statuses, progress)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - start
+    events_per_sec = batch * reps / elapsed
+    return {
+        "metric": "aggregation_events_per_sec",
+        "value": round(events_per_sec),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    msgs_per_sec = bench_service()
+    secondary = bench_aggregation()
+    print(
+        json.dumps(
+            {
+                "metric": "telemetry_msgs_per_sec",
+                "value": round(msgs_per_sec, 1),
+                "unit": "msg/s",
+                "vs_baseline": 1.0,
+                "note": (
+                    "reference publishes no benchmark numbers "
+                    "(BASELINE.md: published={}); vs_baseline=1.0 by convention"
+                ),
+                "secondary": secondary,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,21 +1,29 @@
 """Test environment setup.
 
-Forces JAX onto a virtual 8-device CPU mesh BEFORE jax is imported anywhere,
-so sharding/parallel tests exercise real multi-device code paths without TPU
-hardware. Must run at conftest import time (env vars are read once at backend
-init).
+Forces JAX onto a virtual 8-device CPU mesh so sharding/parallel tests
+exercise real multi-device code paths without TPU hardware.
+
+Two subtleties:
+- env vars alone are NOT enough: jaxtyping's pytest plugin imports jax
+  before this conftest runs, and jax latches ``JAX_PLATFORMS`` at import —
+  so the platform must be forced via ``jax.config.update`` as well;
+- ``XLA_FLAGS`` is only read at backend creation, which has not happened
+  yet at conftest time, so setting it here still works.
 """
 
 import os
+import sys
+from pathlib import Path
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys  # noqa: E402
-from pathlib import Path  # noqa: E402
+import jax  # noqa: E402
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+jax.config.update("jax_platforms", "cpu")

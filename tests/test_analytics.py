@@ -1,0 +1,104 @@
+"""Analytics sink + its service integration."""
+
+import pytest
+
+from beholder_tpu import proto
+from beholder_tpu.analytics import AnalyticsSink
+from beholder_tpu.clients import RecordingTransport
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.mq import InMemoryBroker
+from beholder_tpu.service import PROGRESS_TOPIC, BeholderService
+from beholder_tpu.storage import MemoryStorage
+
+S = proto.TelemetryStatusEntry
+
+
+def test_sink_flushes_at_threshold():
+    sink = AnalyticsSink(flush_every=4)
+    assert sink.record(S.CONVERTING, 10) is None
+    assert sink.record(S.CONVERTING, 20) is None
+    assert sink.record(S.UPLOADING, 90) is None
+    summary = sink.record(S.CONVERTING, 30)
+    assert summary is not None
+    assert sink.buffered == 0
+    assert summary["converting"] == {
+        "count": 3,
+        "mean_progress": 20.0,
+        "max_progress": 30.0,
+    }
+    assert summary["uploading"]["count"] == 1
+
+
+def test_sink_flush_empty_is_noop():
+    sink = AnalyticsSink(flush_every=4)
+    assert sink.flush() is None
+
+
+def test_sink_rejects_bad_threshold():
+    with pytest.raises(ValueError):
+        AnalyticsSink(flush_every=0)
+
+
+def _analytics_service(flush_every=2):
+    broker = InMemoryBroker()
+    db = MemoryStorage()
+    db.add_media(
+        proto.Media(id="m1", creator=proto.CreatorType.TRELLO, creatorId="c1")
+    )
+    transport = RecordingTransport()
+    config = ConfigNode(
+        {
+            "keys": {"trello": {"key": "K", "token": "T"}},
+            "instance": {
+                "analytics": {"enabled": True, "flush_every": flush_every}
+            },
+        }
+    )
+    service = BeholderService(config, broker, db, transport=transport)
+    service.start()
+    return service, broker, transport
+
+
+def _publish_progress(broker, pct):
+    broker.publish(
+        PROGRESS_TOPIC,
+        proto.encode(
+            proto.TelemetryProgress(mediaId="m1", status=S.CONVERTING, progress=pct)
+        ),
+    )
+
+
+def test_service_records_progress_into_sink():
+    service, broker, transport = _analytics_service(flush_every=2)
+    for pct in (10, 20, 30):
+        _publish_progress(broker, pct)
+    # threshold 2: first two observations handed to the async worker,
+    # third still buffered; the consumer thread never blocks on XLA
+    assert service.analytics.buffered == 1
+    service.analytics.drain()
+
+
+def test_analytics_failure_disables_sink_but_parity_path_survives():
+    service, broker, transport = _analytics_service()
+
+    def boom(status, progress):
+        raise RuntimeError("accelerator stack broken")
+
+    service.analytics.record = boom
+    _publish_progress(broker, 42)
+    # sink disabled, message still acked AND the Trello comment still sent
+    assert service.analytics is None
+    assert broker.in_flight == 0
+    assert any("comments" in r.url for r in transport.requests)
+    _publish_progress(broker, 43)  # keeps working without analytics
+    assert broker.in_flight == 0
+
+
+def test_service_without_analytics_config_has_no_sink():
+    service = BeholderService(
+        ConfigNode({"keys": {"trello": {"key": "K", "token": "T"}}}),
+        InMemoryBroker(),
+        MemoryStorage(),
+        transport=RecordingTransport(),
+    )
+    assert service.analytics is None

@@ -1,0 +1,68 @@
+"""Anomaly model: windowing, training convergence, anomaly separation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.models import (
+    anomaly_scores,
+    init_train_state,
+    make_windows,
+    train_step,
+)
+from beholder_tpu.models.anomaly import FEATURES, WINDOW
+from beholder_tpu.proto import TelemetryStatusEntry
+
+CONVERTING = TelemetryStatusEntry.CONVERTING
+
+
+def synthetic_stream(t=512, rate=1.0, noise=0.05, seed=0):
+    """A healthy encode job: progress climbs ~linearly under CONVERTING."""
+    rng = np.random.default_rng(seed)
+    progress = np.cumsum(rate + rng.normal(0, noise, size=t)).clip(0)
+    statuses = np.full(t, CONVERTING)
+    return jnp.asarray(progress), jnp.asarray(statuses)
+
+
+def test_make_windows_shapes_and_targets():
+    progress, statuses = synthetic_stream(t=64)
+    w, t = make_windows(progress, statuses)
+    assert w.shape == (63 - WINDOW, WINDOW * FEATURES)
+    assert t.shape == (63 - WINDOW,)
+    # target of window 0 is the delta right after it
+    deltas = jnp.diff(progress)
+    assert float(t[0]) == pytest.approx(float(deltas[WINDOW]))
+
+
+def test_training_reduces_loss():
+    progress, statuses = synthetic_stream()
+    windows, targets = make_windows(progress, statuses)
+    state, tx = init_train_state(jax.random.PRNGKey(0))
+    step = jax.jit(lambda s, w, t: train_step(s, tx, w, t))
+
+    _, first_loss = step(state, windows, targets)
+    for _ in range(60):
+        state, loss = step(state, windows, targets)
+    assert float(loss) < float(first_loss) * 0.5
+    assert int(state.step) == 60  # first_loss call above discarded its state
+
+
+def test_anomaly_scores_flag_stalled_job():
+    progress, statuses = synthetic_stream()
+    windows, targets = make_windows(progress, statuses)
+    state, tx = init_train_state(jax.random.PRNGKey(0))
+    step = jax.jit(lambda s, w, t: train_step(s, tx, w, t))
+    for _ in range(200):
+        state, _ = step(state, windows, targets)
+
+    healthy = float(anomaly_scores(state.params, windows, targets).mean())
+
+    # a stalled job: progress freezes while status still says CONVERTING
+    stalled = np.asarray(progress).copy()
+    stalled[256:] = stalled[256]
+    sw, st = make_windows(jnp.asarray(stalled), statuses)
+    # score only the windows that straddle the stall onset
+    onset = slice(250 - WINDOW, 260)
+    stalled_score = float(anomaly_scores(state.params, sw[onset], st[onset]).mean())
+    assert stalled_score > healthy * 3

@@ -1,0 +1,55 @@
+"""Aggregation ops: numerics vs a numpy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from beholder_tpu.ops import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
+
+
+def test_status_counts_matches_numpy():
+    rng = np.random.default_rng(0)
+    statuses = rng.integers(0, NUM_STATUSES, size=1000)
+    got = np.asarray(status_counts(jnp.asarray(statuses)))
+    want = np.bincount(statuses, minlength=NUM_STATUSES)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_aggregate_telemetry_matches_numpy():
+    rng = np.random.default_rng(1)
+    statuses = rng.integers(0, NUM_STATUSES, size=4096)
+    progress = rng.integers(0, 101, size=4096)
+    out = aggregate_telemetry(jnp.asarray(statuses), jnp.asarray(progress))
+
+    for s in range(NUM_STATUSES):
+        mask = statuses == s
+        assert int(out["count"][s]) == mask.sum()
+        if mask.any():
+            np.testing.assert_allclose(
+                float(out["mean_progress"][s]), progress[mask].mean(), rtol=1e-5
+            )
+            assert float(out["max_progress"][s]) == progress[mask].max()
+            assert float(out["min_progress"][s]) == progress[mask].min()
+
+
+def test_aggregate_handles_empty_statuses():
+    # only status 0 present: the other rows must be zeros, not garbage
+    statuses = jnp.zeros(16, dtype=jnp.int32)
+    progress = jnp.full(16, 50)
+    out = aggregate_telemetry(statuses, progress)
+    assert int(out["count"][0]) == 16
+    for s in range(1, NUM_STATUSES):
+        assert int(out["count"][s]) == 0
+        assert float(out["mean_progress"][s]) == 0.0
+        assert float(out["max_progress"][s]) == 0.0
+
+
+def test_ewma_matches_reference_impl():
+    series = np.array([0.0, 10.0, 10.0, 10.0, 100.0], dtype=np.float32)
+    alpha = 0.5
+    got = np.asarray(ewma(jnp.asarray(series), alpha))
+    want = np.empty_like(series)
+    acc = series[0]
+    for i, x in enumerate(series):
+        acc = alpha * x + (1 - alpha) * acc
+        want[i] = acc
+    np.testing.assert_allclose(got, want, rtol=1e-6)
