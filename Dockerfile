@@ -1,0 +1,25 @@
+# Runtime image for the beholder-tpu service.
+# The reference builds FROM tritonmedia/base (external, CMD defined there);
+# this image is self-contained instead.
+
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# protoc is NOT needed: generated api_pb2.py is committed
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY pyproject.toml README.md ./
+COPY beholder_tpu ./beholder_tpu
+COPY native ./native
+COPY Makefile ./
+
+RUN pip install --no-cache-dir . && make native
+
+# the package is imported from site-packages, so point it at the built
+# scanner explicitly (its relative search paths don't cover /app)
+ENV BEHOLDER_FRAMECODEC_LIB=/app/native/build/libframecodec.so
+
+CMD ["beholder"]

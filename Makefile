@@ -1,0 +1,37 @@
+# Build/test entrypoints, mirroring the reference's Makefile role
+# (/root/reference/Makefile generates CI config; here the targets cover the
+# whole dev loop since this rebuild actually has tests and native code).
+
+PROTOC ?= protoc
+CXX ?= g++
+
+.PHONY: all proto native test bench lint clean
+
+all: proto native
+
+proto:
+	$(PROTOC) --python_out=beholder_tpu/proto -I beholder_tpu/proto \
+		beholder_tpu/proto/api.proto
+
+native: native/build/libframecodec.so
+
+native/build/libframecodec.so: native/framecodec.cc
+	mkdir -p native/build
+	$(CXX) -O2 -Wall -Wextra -shared -fPIC -o $@ $<
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+lint:
+	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \
+		python -m ruff check beholder_tpu tests bench.py __graft_entry__.py; \
+	else \
+		echo "ruff unavailable; falling back to a syntax gate"; \
+		python -m compileall -q beholder_tpu tests bench.py __graft_entry__.py; \
+	fi
+
+clean:
+	rm -rf native/build

@@ -1,0 +1,125 @@
+"""ctypes bridge to the native frame scanner (native/framecodec.cc).
+
+Loads ``libframecodec.so`` when it has been built (``make native``); the
+pure-Python FrameParser is the fallback, so the package works unbuilt.
+
+The scanner is zero-copy on input: the parser's accumulation buffer is
+exported to C via ``from_buffer`` (no per-feed ``bytes()`` copy — that
+would make chunked large-body parsing O(N^2)), and the ctypes scratch
+arrays live for the scanner's lifetime instead of being reallocated per
+call. Payload bytes are copied out exactly once.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_LIB_NAMES = ("libframecodec.so",)
+_SEARCH_DIRS = (
+    Path(__file__).resolve().parent.parent.parent / "native" / "build",
+    Path(__file__).resolve().parent,
+)
+
+_MAX_FRAMES = 4096
+
+
+def _load() -> ctypes.CDLL | None:
+    override = os.environ.get("BEHOLDER_FRAMECODEC_LIB")
+    candidates = (
+        [Path(override)]
+        if override
+        else [d / n for d in _SEARCH_DIRS for n in _LIB_NAMES]
+    )
+    for path in candidates:
+        if path.is_file():
+            try:
+                lib = ctypes.CDLL(str(path))
+            except OSError:
+                continue
+            lib.amqp_scan_frames.restype = ctypes.c_int64
+            lib.amqp_scan_frames.argtypes = [
+                ctypes.POINTER(ctypes.c_char),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            return lib
+    return None
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+class NativeScanner:
+    """Per-parser scanner holding reusable scratch arrays."""
+
+    def __init__(self):
+        if _lib is None:
+            raise RuntimeError("native frame codec not built (run `make native`)")
+        self._types = (ctypes.c_int32 * _MAX_FRAMES)()
+        self._channels = (ctypes.c_int32 * _MAX_FRAMES)()
+        self._offsets = (ctypes.c_int64 * _MAX_FRAMES)()
+        self._sizes = (ctypes.c_int64 * _MAX_FRAMES)()
+        self._consumed = ctypes.c_int64(0)
+
+    def scan(self, buf: bytearray) -> tuple[list[tuple[int, int, bytes]], int]:
+        """Scan ``buf`` for complete frames without copying it.
+
+        Returns (frames, consumed); the caller trims ``buf[:consumed]``
+        afterwards (all buffer exports are released before returning).
+        Raises ``ValueError`` on a bad frame-end octet.
+        """
+        frames: list[tuple[int, int, bytes]] = []
+        total = len(buf)
+        if total < 8:
+            return frames, 0
+        cbuf = (ctypes.c_char * total).from_buffer(buf)
+        mv = memoryview(buf)
+        consumed_total = 0
+        try:
+            while True:
+                ptr = ctypes.cast(
+                    ctypes.byref(cbuf, consumed_total),
+                    ctypes.POINTER(ctypes.c_char),
+                )
+                n = _lib.amqp_scan_frames(
+                    ptr,
+                    total - consumed_total,
+                    self._types,
+                    self._channels,
+                    self._offsets,
+                    self._sizes,
+                    _MAX_FRAMES,
+                    ctypes.byref(self._consumed),
+                )
+                if n < 0:
+                    raise ValueError(
+                        "bad frame end at buffer offset "
+                        f"{consumed_total + self._consumed.value}"
+                    )
+                for i in range(n):
+                    start = consumed_total + self._offsets[i]
+                    frames.append(
+                        (
+                            self._types[i],
+                            self._channels[i],
+                            bytes(mv[start : start + self._sizes[i]]),
+                        )
+                    )
+                consumed_total += self._consumed.value
+                if n < _MAX_FRAMES:
+                    return frames, consumed_total
+        finally:
+            # release buffer exports so the caller may resize ``buf``
+            mv.release()
+            del cbuf

@@ -262,13 +262,37 @@ def parse_method(frame: Frame) -> tuple[tuple[int, int], Reader]:
 
 
 class FrameParser:
-    """Incremental byte-stream -> frame parser."""
+    """Incremental byte-stream -> frame parser.
 
-    def __init__(self):
+    Uses the native scanner (native/framecodec.cc via ctypes) when built,
+    which locates all frames in one C pass; otherwise a pure-Python walk.
+    """
+
+    def __init__(self, use_native: bool | None = None):
         self._buf = bytearray()
+        self._scanner = None
+        if use_native is None:
+            from . import _native
+
+            if _native.available():
+                self._scanner = _native.NativeScanner()
+        elif use_native:
+            from . import _native
+
+            self._scanner = _native.NativeScanner()  # raises if unbuilt
 
     def feed(self, data: bytes) -> list[Frame]:
         self._buf.extend(data)
+        if self._scanner is not None:
+            try:
+                scanned, consumed = self._scanner.scan(self._buf)
+            except ValueError as err:
+                raise ProtocolError(str(err)) from None
+            del self._buf[:consumed]
+            return [Frame(t, c, p) for t, c, p in scanned]
+        return self._feed_python()
+
+    def _feed_python(self) -> list[Frame]:
         frames = []
         while True:
             if len(self._buf) < 7:

@@ -1,0 +1,72 @@
+"""Native frame scanner: differential tests against the pure-Python parser.
+
+Skipped when libframecodec.so hasn't been built (``make native``).
+"""
+
+import numpy as np
+import pytest
+
+from beholder_tpu.mq import _native, codec
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native codec not built (run `make native`)"
+)
+
+
+def _random_stream(seed, n_frames=200):
+    rng = np.random.default_rng(seed)
+    out = bytearray()
+    expect = []
+    for _ in range(n_frames):
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            f = codec.method_frame(
+                int(rng.integers(0, 3)), codec.BASIC_ACK, bytes(rng.integers(0, 256, 9, dtype=np.uint8))
+            )
+        elif kind == 1:
+            f = codec.heartbeat_frame()
+        else:
+            payload = bytes(rng.integers(0, 256, int(rng.integers(0, 2000)), dtype=np.uint8))
+            f = codec.Frame(codec.FRAME_BODY, int(rng.integers(0, 3)), payload)
+        expect.append(f)
+        out += f.serialize()
+    return bytes(out), expect
+
+
+def _assert_same(got, expect):
+    assert [(f.type, f.channel, f.payload) for f in got] == [
+        (f.type, f.channel, f.payload) for f in expect
+    ]
+
+
+def test_native_matches_python_bulk():
+    stream, expect = _random_stream(0)
+    native = codec.FrameParser(use_native=True).feed(stream)
+    pure = codec.FrameParser(use_native=False).feed(stream)
+    _assert_same(native, expect)
+    _assert_same(pure, expect)
+
+
+def test_native_incremental_feeding_retains_partial():
+    stream, expect = _random_stream(1, n_frames=40)
+    parser = codec.FrameParser(use_native=True)
+    got = []
+    step = 13  # misaligned with frame boundaries on purpose
+    for i in range(0, len(stream), step):
+        got.extend(parser.feed(stream[i : i + step]))
+    _assert_same(got, expect)
+
+
+def test_native_bad_frame_end_raises_protocol_error():
+    bad = bytearray(codec.heartbeat_frame().serialize())
+    bad[-1] = 0x00
+    with pytest.raises(codec.ProtocolError):
+        codec.FrameParser(use_native=True).feed(bytes(bad))
+
+
+def test_native_handles_more_frames_than_batch_limit():
+    # one feed() with more frames than the ctypes batch size (4096)
+    frame = codec.heartbeat_frame().serialize()
+    stream = frame * 5000
+    got = codec.FrameParser(use_native=True).feed(stream)
+    assert len(got) == 5000
