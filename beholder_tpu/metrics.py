@@ -45,6 +45,18 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def labels(self, **labels: str) -> "_BoundCounter":
+        """A bound child for one label combination (prom-client pattern);
+        hot paths cache these to skip per-call label validation."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
+            )
+        key = tuple(str(labels[name]) for name in self.labelnames)
+        with self._lock:
+            self._values.setdefault(key, 0.0)
+        return _BoundCounter(self, key)
+
     def value(self, **labels: str) -> float:
         key = tuple(str(labels[name]) for name in self.labelnames)
         with self._lock:
@@ -66,6 +78,18 @@ class Counter:
             else:
                 lines.append(f"{self.name} {_fmt(value)}")
         return "\n".join(lines)
+
+
+class _BoundCounter:
+    __slots__ = ("_counter", "_key")
+
+    def __init__(self, counter: Counter, key: tuple[str, ...]):
+        self._counter = counter
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._counter._lock:
+            self._counter._values[self._key] += amount
 
 
 def _fmt(value: float) -> str:

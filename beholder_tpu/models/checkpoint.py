@@ -1,0 +1,40 @@
+"""Checkpoint/resume for the anomaly model (orbax-backed).
+
+EXTENSION: the reference is stateless (its state lives in Postgres —
+SURVEY.md §5 "Checkpoint / resume: absent"), but the analytics extension
+trains a model, and a trained model is state worth persisting. Orbax is
+the idiomatic JAX checkpointer: async-capable, sharding-aware, and it
+restores arrays onto whatever mesh the template pytree prescribes, so a
+checkpoint written on one topology restores onto another.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from .anomaly import TrainState
+
+
+def save_state(path: str | Path, state: TrainState) -> None:
+    """Write ``state`` (params + optimizer moments + step) to ``path``.
+
+    Overwrites an existing checkpoint at ``path`` (``force=True``) so the
+    periodic save-to-fixed-"latest"-path workflow works."""
+    path = Path(path).resolve()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+        ckptr.wait_until_finished()
+
+
+def restore_state(path: str | Path, template: TrainState) -> TrainState:
+    """Restore a TrainState; ``template`` supplies structure, dtypes, and
+    (optionally) target shardings — pass a mesh-placed template to restore
+    directly onto a device mesh."""
+    path = Path(path).resolve()
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract)

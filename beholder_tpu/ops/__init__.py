@@ -11,5 +11,12 @@ reference; parity components live in the sibling packages.
 """
 
 from .aggregate import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
+from .pallas_aggregate import aggregate_telemetry_pallas
 
-__all__ = ["NUM_STATUSES", "aggregate_telemetry", "status_counts", "ewma"]
+__all__ = [
+    "NUM_STATUSES",
+    "aggregate_telemetry",
+    "aggregate_telemetry_pallas",
+    "status_counts",
+    "ewma",
+]

@@ -66,8 +66,19 @@ class BeholderService:
             emby_host, config.get("keys.emby.token", ""), transport=transport
         )
 
-        #: status-name (lowercase) -> Trello list id (index.js:60)
-        self.flow_ids = config.get("instance.flow_ids") or ConfigNode({})
+        #: status-name (lowercase) -> Trello list id (index.js:60).
+        #: Config is load-once in the reference too (triton-core Config),
+        #: so resolving it to plain values here is parity-safe and keeps
+        #: dotted lookups out of the per-message hot path.
+        flow = config.get("instance.flow_ids") or ConfigNode({})
+        self.flow_ids = flow.to_dict() if isinstance(flow, ConfigNode) else dict(flow)
+        self._telegram_enabled = bool(config.get("instance.telegram.enabled"))
+        self._telegram_channel = config.get("instance.telegram.channel")
+        self._emby_enabled = bool(
+            config.get("keys.emby.token") and config.get("instance.emby.enabled")
+        )
+        self._emby_host = config.get("instance.emby.host")
+        self._progress_counters = {}  # status text -> bound counter child
 
         #: optional batch-analytics extension (not part of reference parity)
         self.analytics = None
@@ -139,22 +150,17 @@ class BeholderService:
                 self._status_proto, "TelemetryStatusEntry", "DEPLOYED"
             )
             if media.status == deployed:
-                if self.config.get("instance.telegram.enabled"):
+                if self._telegram_enabled:
                     self.logger.info(
                         f"informing telegram that media '{media_id}' is available"
                     )
                     self.telegram.notify_deployed(
-                        self.config.get("instance.telegram.channel"),
-                        media.name,
-                        media.metadataId,
+                        self._telegram_channel, media.name, media.metadataId
                     )
 
-                if self.config.get("keys.emby.token") and self.config.get(
-                    "instance.emby.enabled"
-                ):
+                if self._emby_enabled:
                     self.logger.info(
-                        "telling emby to refresh at "
-                        f"{self.config.get('instance.emby.host')}"
+                        f"telling emby to refresh at {self._emby_host}"
                     )
                     self.emby.refresh_library()
         except Exception as err:  # noqa: BLE001 - parity with index.js:120-122
@@ -177,7 +183,13 @@ class BeholderService:
                 self._progress_proto, "TelemetryStatusEntry", status
             )
 
-            self.metrics.progress_updates_total.inc(status=status_text.lower())
+            counter = self._progress_counters.get(status_text)
+            if counter is None:
+                counter = self.metrics.progress_updates_total.labels(
+                    status=status_text.lower()
+                )
+                self._progress_counters[status_text] = counter
+            counter.inc()
 
             if self.analytics is not None:
                 try:

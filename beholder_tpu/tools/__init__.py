@@ -1,0 +1,1 @@
+"""Operator tools: the telemetry producer CLI (`beholder-publish`)."""

@@ -1,0 +1,85 @@
+"""Telemetry producer CLI.
+
+The reference ecosystem's producers (the triton converter/downloader
+services) publish ``api.TelemetryStatus`` / ``api.TelemetryProgress``
+protos to RabbitMQ; beholder only consumes them. This tool is the
+operator-side counterpart for smoke tests and backfills:
+
+    beholder-publish status   --media-id m1 --status DEPLOYED
+    beholder-publish progress --media-id m1 --status CONVERTING \
+        --progress 55 --host enc-1
+    beholder-publish status ... --url amqp://user:pw@host:5672/
+
+``--url`` defaults to ``dyn('rabbitmq')`` resolution, same as the service.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from beholder_tpu import proto
+from beholder_tpu.config import dyn
+from beholder_tpu.mq.amqp import AmqpBroker
+from beholder_tpu.service import PROGRESS_TOPIC, STATUS_TOPIC
+
+STATUS_NAMES = list(proto.TelemetryStatusEntry.keys())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="beholder-publish", description=__doc__.split("\n\n")[0]
+    )
+    parser.add_argument("--url", default=None, help="amqp:// broker URL")
+    sub = parser.add_subparsers(dest="kind", required=True)
+
+    status = sub.add_parser("status", help="publish a status transition")
+    progress = sub.add_parser("progress", help="publish a progress update")
+    for p in (status, progress):
+        p.add_argument("--media-id", required=True)
+        p.add_argument("--status", required=True, choices=STATUS_NAMES)
+        # accepted after the subcommand too; SUPPRESS keeps a post-subcommand
+        # default from clobbering a pre-subcommand value
+        p.add_argument("--url", default=argparse.SUPPRESS, help=argparse.SUPPRESS)
+    progress.add_argument("--progress", type=int, required=True, metavar="PCT")
+    progress.add_argument("--host", default="")
+    return parser
+
+
+def encode_message(args: argparse.Namespace) -> tuple[str, bytes]:
+    status = proto.TelemetryStatusEntry.Value(args.status)
+    if args.kind == "status":
+        return STATUS_TOPIC, proto.encode(
+            proto.TelemetryStatus(mediaId=args.media_id, status=status)
+        )
+    if not 0 <= args.progress <= 100:
+        raise SystemExit(f"--progress must be 0..100, got {args.progress}")
+    return PROGRESS_TOPIC, proto.encode(
+        proto.TelemetryProgress(
+            mediaId=args.media_id,
+            status=status,
+            progress=args.progress,
+            host=args.host,
+        )
+    )
+
+
+def main(argv: list[str] | None = None, broker: AmqpBroker | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    topic, body = encode_message(args)
+
+    own_broker = broker is None
+    if own_broker:
+        broker = AmqpBroker(args.url or dyn("rabbitmq"))
+        broker.connect(timeout=10)
+    try:
+        broker.publish(topic, body)
+    finally:
+        if own_broker:
+            broker.close()
+    print(f"published {args.kind} for {args.media_id} to {topic}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Pallas aggregation kernel vs the XLA path and a numpy oracle.
+
+Runs in pallas interpreter mode on the CPU test mesh; the same kernel
+compiles natively on TPU.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from beholder_tpu.ops import NUM_STATUSES, aggregate_telemetry
+from beholder_tpu.ops.pallas_aggregate import aggregate_telemetry_pallas
+
+
+def _compare(statuses, progress):
+    got = aggregate_telemetry_pallas(jnp.asarray(statuses), jnp.asarray(progress))
+    ref = aggregate_telemetry(jnp.asarray(statuses), jnp.asarray(progress))
+    np.testing.assert_array_equal(np.asarray(got["count"]), np.asarray(ref["count"]))
+    np.testing.assert_allclose(
+        np.asarray(got["mean_progress"]), np.asarray(ref["mean_progress"]), rtol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["max_progress"]), np.asarray(ref["max_progress"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["min_progress"]), np.asarray(ref["min_progress"])
+    )
+
+
+def test_matches_xla_path_exact_tile_multiple():
+    rng = np.random.default_rng(0)
+    _compare(
+        rng.integers(0, NUM_STATUSES, size=4096), rng.integers(0, 101, size=4096)
+    )
+
+
+def test_matches_xla_path_with_padding():
+    rng = np.random.default_rng(1)
+    # 2500 is not a multiple of 1024: exercises the -1 padding path
+    _compare(
+        rng.integers(0, NUM_STATUSES, size=2500), rng.integers(0, 101, size=2500)
+    )
+
+
+def test_single_status_and_missing_statuses():
+    statuses = np.full(1500, 3)
+    progress = np.linspace(0, 100, 1500)
+    got = aggregate_telemetry_pallas(jnp.asarray(statuses), jnp.asarray(progress))
+    assert int(got["count"][3]) == 1500
+    for s in range(NUM_STATUSES):
+        if s != 3:
+            assert int(got["count"][s]) == 0
+            assert float(got["max_progress"][s]) == 0.0
+
+
+def test_tiny_batch():
+    _compare(np.array([0, 5, 5]), np.array([10, 20, 30]))
+
+
+def test_empty_batch():
+    got = aggregate_telemetry_pallas(
+        jnp.array([], dtype=jnp.int32), jnp.array([], dtype=jnp.float32)
+    )
+    assert np.asarray(got["count"]).sum() == 0
+    assert float(np.asarray(got["mean_progress"]).sum()) == 0.0
