@@ -13,6 +13,12 @@ from .anomaly import (
     make_windows,
     train_step,
 )
+from .sequence import (
+    TelemetrySequenceModel,
+    init_seq_state,
+    seq_train_step,
+    stream_features,
+)
 
 __all__ = [
     "ProgressAnomalyModel",
@@ -20,4 +26,8 @@ __all__ = [
     "init_train_state",
     "train_step",
     "anomaly_scores",
+    "TelemetrySequenceModel",
+    "init_seq_state",
+    "seq_train_step",
+    "stream_features",
 ]

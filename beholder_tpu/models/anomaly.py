@@ -15,7 +15,7 @@ TPU-first design choices:
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +23,8 @@ import optax
 from flax import linen as nn
 
 from beholder_tpu.ops import NUM_STATUSES
+
+from .train import TrainState, apply_gradients
 
 WINDOW = 16  # observations per window
 FEATURES = 1 + NUM_STATUSES  # progress delta + one-hot status
@@ -43,12 +45,6 @@ class ProgressAnomalyModel(nn.Module):
         x = nn.relu(x)
         x = nn.Dense(1, name="out_proj", dtype=jnp.float32)(x)
         return x[..., 0].astype(jnp.float32)
-
-
-class TrainState(NamedTuple):
-    params: Any
-    opt_state: Any
-    step: jax.Array
 
 
 def make_windows(
@@ -98,10 +94,7 @@ def train_step(
 ) -> tuple[TrainState, jax.Array]:
     """One SGD step. Pure function — jit/pjit it at the call site so the
     same code serves single-chip and sharded execution."""
-    loss, grads = jax.value_and_grad(loss_fn)(state.params, windows, targets)
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    return TrainState(params, opt_state, state.step + 1), loss
+    return apply_gradients(state, tx, lambda p: loss_fn(p, windows, targets))
 
 
 def anomaly_scores(params: Any, windows: jax.Array, targets: jax.Array) -> jax.Array:

@@ -1,0 +1,128 @@
+"""Long-context telemetry sequence model.
+
+EXTENSION BEYOND THE REFERENCE. A small causal transformer over telemetry
+streams — per-step features are (progress delta, one-hot status), targets
+are next-step deltas (same self-supervision as the MLP flagship, but over
+arbitrarily long streams). The attention backend is pluggable:
+
+- ``attention="full"``  — O(T^2) on one device (short streams)
+- ``attention="ring"``  — context-parallel ring attention over an ``sp``
+  mesh axis (:func:`beholder_tpu.ops.attention.ring_attention`): each
+  device holds T/P of the stream, k/v blocks rotate over ICI, memory per
+  device stays O(T/P * d). This is how week-long telemetry streams score
+  without a single-chip memory wall.
+
+TPU-first notes: static shapes throughout; bfloat16 matmuls with float32
+accumulation; heads/features sized for MXU tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from beholder_tpu.ops import NUM_STATUSES
+from beholder_tpu.ops.attention import full_attention, ring_attention
+
+from .train import TrainState, apply_gradients
+
+FEATURES = 1 + NUM_STATUSES
+
+
+class Block(nn.Module):
+    dim: int
+    heads: int
+    attention: str = "full"  # "full" | "ring"
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        h = self.heads
+        y = nn.LayerNorm()(x)
+        qkv = nn.Dense(3 * d, name="qkv", dtype=jnp.bfloat16)(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # (B, T, D) -> (B, H, T, Dh): leading dims pass through attention
+        q, k, v = (
+            a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3) for a in (q, k, v)
+        )
+        if self.attention == "ring":
+            if self.mesh is None:
+                raise ValueError("ring attention needs a mesh")
+            att = ring_attention(q, k, v, self.mesh, causal=True)
+        else:
+            att = full_attention(q, k, v, causal=True)
+        att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
+        x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
+
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(d, name="down", dtype=jnp.bfloat16)(y).astype(x.dtype)
+        return x
+
+
+class TelemetrySequenceModel(nn.Module):
+    """Causal next-delta predictor over telemetry streams."""
+
+    dim: int = 128
+    heads: int = 4
+    layers: int = 2
+    attention: str = "full"
+    mesh: Mesh | None = None
+
+    @nn.compact
+    def __call__(self, feats: jax.Array) -> jax.Array:
+        """(B, T, FEATURES) -> (B, T) predicted next delta per position."""
+        x = nn.Dense(self.dim, name="embed")(feats.astype(jnp.float32))
+        for i in range(self.layers):
+            x = Block(
+                self.dim,
+                self.heads,
+                attention=self.attention,
+                mesh=self.mesh,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(1, name="head", dtype=jnp.float32)(x)[..., 0]
+
+
+def stream_features(progress: jax.Array, statuses: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(B, T+1) progress / (B, T+1) statuses -> (B, T, F) feats, (B, T) targets.
+
+    Feature t is (delta_t, one-hot status_t); target t is delta_{t+1}
+    (last position's target is a zero pad, masked out by the loss).
+    """
+    deltas = jnp.diff(progress.astype(jnp.float32), axis=-1)  # (B, T)
+    oh = jax.nn.one_hot(statuses[:, 1:], NUM_STATUSES)
+    feats = jnp.concatenate([deltas[..., None], oh], axis=-1)
+    targets = jnp.concatenate(
+        [deltas[:, 1:], jnp.zeros_like(deltas[:, :1])], axis=-1
+    )
+    return feats, targets
+
+
+def seq_loss(model: TelemetrySequenceModel, params, feats, targets) -> jax.Array:
+    pred = model.apply(params, feats)
+    err = (pred - targets) ** 2
+    mask = jnp.ones_like(err).at[:, -1].set(0.0)  # last target is padding
+    return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def init_seq_state(
+    rng: jax.Array,
+    seq_len: int,
+    model: TelemetrySequenceModel | None = None,
+    learning_rate: float = 1e-3,
+) -> tuple[TrainState, optax.GradientTransformation, TelemetrySequenceModel]:
+    model = model or TelemetrySequenceModel()
+    params = model.init(rng, jnp.zeros((1, seq_len, FEATURES)))
+    tx = optax.adam(learning_rate)
+    return TrainState(params, tx.init(params), jnp.int32(0)), tx, model
+
+
+def seq_train_step(model, tx, state: TrainState, feats, targets):
+    return apply_gradients(state, tx, lambda p: seq_loss(model, p, feats, targets))

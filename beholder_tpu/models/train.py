@@ -1,0 +1,26 @@
+"""Shared training-state machinery for all models in this package."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import optax
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def apply_gradients(
+    state: TrainState,
+    tx: optax.GradientTransformation,
+    loss_fn: Callable[[Any], jax.Array],
+) -> tuple[TrainState, jax.Array]:
+    """One optimizer step of ``loss_fn(params)``; pure, jit/pjit-friendly."""
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss

@@ -1,0 +1,138 @@
+"""Attention ops: full reference + ring attention (context parallelism).
+
+EXTENSION BEYOND THE REFERENCE (which has no attention, no sequences, no
+tensors — SURVEY.md §5 "Long-context / sequence parallelism: Absent").
+Built for scoring long telemetry streams with the sequence models in
+:mod:`beholder_tpu.models.sequence`.
+
+Ring attention (context parallelism over a mesh axis):
+- q, k, v are sharded along the sequence dimension across the ``sp`` mesh
+  axis; each device holds one block.
+- P-1 rotation steps pass k/v blocks around the ring with ``ppermute``
+  (riding ICI on TPU hardware) while each device accumulates attention of
+  its local q block against every k/v block using the online-softmax
+  (flash) recurrence — running max ``m``, normalizer ``l``, and
+  unnormalized output ``o`` — so the full (T, T) score matrix never
+  materializes and per-device memory stays O(T/P * d).
+- Causal masking works on global positions: block offsets are rotated
+  alongside the blocks, so each device always knows which global rows its
+  current k/v block came from.
+
+The same code runs single-device (P=1 degenerates to flash attention over
+one block) and on the virtual CPU mesh used by the tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Reference O(T^2) attention. Shapes: (..., T, d) -> (..., T, d)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool))
+        scores = jnp.where(mask, scores, _NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", weights.astype(q.dtype), v)
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, causal):
+    """Scores of a local q block vs one k/v block + flash partials.
+
+    Returns (m, p_sum, pv): row max, exp-sum, and exp-weighted values of
+    this block, for the online-softmax combine.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(jnp.float32(d))
+    scores = scores.astype(jnp.float32)
+    if causal:
+        tq, tk = q.shape[-2], k.shape[-2]
+        rows = q_offset + jnp.arange(tq)[:, None]
+        cols = kv_offset + jnp.arange(tk)[None, :]
+        scores = jnp.where(rows >= cols, scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (..., tq)
+    p = jnp.exp(scores - m[..., None])
+    p_sum = jnp.sum(p, axis=-1)
+    pv = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, p_sum, pv
+
+
+def _combine(state, block):
+    """Online-softmax combine of running (m, l, o) with a new block."""
+    m, l, o = state
+    bm, bl, bo = block
+    m_new = jnp.maximum(m, bm)
+    scale_old = jnp.exp(m - m_new)
+    scale_new = jnp.exp(bm - m_new)
+    l_new = l * scale_old + bl * scale_new
+    o_new = o * scale_old[..., None] + bo * scale_new[..., None]
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+) -> jax.Array:
+    """Context-parallel attention over the ``axis`` dimension of ``mesh``.
+
+    Inputs are (..., T, d) global arrays; T must divide evenly by the axis
+    size. Output matches :func:`full_attention` up to float tolerance.
+    """
+    p_size = mesh.shape[axis]
+    t = q.shape[-2]
+    if t % p_size:
+        raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
+    block = t // p_size
+
+    def local(qb, kb, vb):
+        idx = jax.lax.axis_index(axis)
+        q_offset = idx * block
+
+        m = jnp.full(qb.shape[:-1], _NEG_INF, jnp.float32)
+        l = jnp.zeros(qb.shape[:-1], jnp.float32)
+        o = jnp.zeros(qb.shape, jnp.float32)
+        kc, vc, kv_idx = kb, vb, idx
+
+        # static unroll over the (known) ring size: p_size block attends
+        # with p_size-1 rotations — the last block needs no further hop,
+        # and XLA overlaps each ppermute with the next step's compute
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        for step in range(p_size):
+            blk = _block_attend(qb, kc, vc, q_offset, kv_idx * block, causal)
+            m, l, o = _combine((m, l, o), blk)
+            if step < p_size - 1:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+                kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+
+        # under causal self-attention every row sees at least its own
+        # position, so l >= 1 always; divide directly
+        return (o / l[..., None]).astype(q.dtype)
+
+    spec = P(*([None] * (q.ndim - 2)), axis, None)
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v)
+
+
+def sequence_sharding(mesh: Mesh, ndim: int, axis: str = "sp") -> NamedSharding:
+    """NamedSharding placing the (-2) sequence dim on ``axis``."""
+    return NamedSharding(mesh, P(*([None] * (ndim - 2)), axis, None))

@@ -1,0 +1,73 @@
+"""Ring attention vs full attention on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from beholder_tpu.ops.attention import (
+    full_attention,
+    ring_attention,
+    sequence_sharding,
+)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    devices = np.array(jax.devices()[:8]).reshape(8)
+    return Mesh(devices, ("sp",))
+
+
+def _qkv(seed, batch=2, t=256, d=32):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (batch, t, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in keys)
+
+
+def test_full_attention_softmax_rows_sum_to_one():
+    q, k, v = _qkv(0, batch=1, t=32, d=8)
+    out = full_attention(q, k, jnp.ones_like(v))
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-5)
+
+
+def test_ring_matches_full_noncausal(sp_mesh):
+    q, k, v = _qkv(1)
+    want = full_attention(q, k, v)
+    got = ring_attention(q, k, v, sp_mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_matches_full_causal(sp_mesh):
+    q, k, v = _qkv(2)
+    want = full_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, sp_mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_with_sharded_inputs_stays_sharded(sp_mesh):
+    q, k, v = _qkv(3)
+    shard = sequence_sharding(sp_mesh, q.ndim)
+    q, k, v = (jax.device_put(x, shard) for x in (q, k, v))
+    got = jax.jit(
+        lambda a, b, c: ring_attention(a, b, c, sp_mesh, causal=True)
+    )(q, k, v)
+    want = full_attention(
+        np.asarray(q), np.asarray(k), np.asarray(v), causal=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+    assert "'sp'" in repr(got.sharding.spec)
+
+
+def test_ring_rejects_indivisible_sequence(sp_mesh):
+    q, k, v = _qkv(4, t=250)  # 250 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, sp_mesh)
+
+
+def test_ring_single_device_degenerates_to_flash():
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    q, k, v = _qkv(5, t=64)
+    want = full_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
