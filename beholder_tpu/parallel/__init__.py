@@ -7,6 +7,7 @@ shardings with ``NamedSharding``/``PartitionSpec``, jit the pure train
 step, and let GSPMD insert the collectives.
 """
 
+from .distributed import initialize, make_hybrid_mesh
 from .mesh import (
     batch_sharding,
     make_mesh,
@@ -21,4 +22,6 @@ __all__ = [
     "param_shardings",
     "replicated",
     "sharded_train_step",
+    "initialize",
+    "make_hybrid_mesh",
 ]

@@ -1,0 +1,83 @@
+"""Multi-host initialization and hybrid ICI/DCN meshes.
+
+EXTENSION BEYOND THE REFERENCE (single Node process, SURVEY.md §2). The
+scaling recipe for multi-host TPU pods:
+
+1. every host calls :func:`initialize` (JAX's distributed runtime; no-op
+   for single-process runs),
+2. build a hybrid mesh with :func:`make_hybrid_mesh` — inner axes map to
+   ICI (fast intra-slice links), the outer ``dp`` axis maps to DCN
+   (between slices/hosts),
+3. annotate shardings exactly as on one host; GSPMD routes collectives
+   over the right fabric because the mesh encodes the topology.
+
+Model code is identical single-host and multi-host — only mesh
+construction differs, which is the point of doing it this way.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize JAX's distributed runtime when running multi-process.
+
+    Reads ``JAX_COORDINATOR``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` when
+    arguments are omitted; silently a no-op for single-process runs (so the
+    same entrypoint serves laptops and pods).
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR")
+    if coordinator_address is None:
+        return  # single-process
+    # NB: `x or env` would silently override an explicit process_id=0 with
+    # a stale env var, corrupting cluster membership — test for None
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(ici_tp: int = 2, axis_names=("dp", "tp")) -> Mesh:
+    """A 2-D mesh whose ``tp`` axis stays inside a slice (ICI) and whose
+    ``dp`` axis spans slices/hosts (DCN).
+
+    Single-slice (or CPU test) runs degrade to a plain mesh with the same
+    axis names, so calling code never branches.
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if ici_tp > n or n % ici_tp:
+        raise ValueError(f"ici_tp={ici_tp} does not divide device count {n}")
+    procs = jax.process_count()
+    if procs > 1:
+        # assumes one slice per process (the common v5e/v5p pod-slice
+        # deployment); per-slice dp must be a whole number
+        per_slice = n // procs
+        if per_slice * procs != n or per_slice % ici_tp:
+            raise ValueError(
+                f"{n} devices over {procs} processes with ici_tp={ici_tp}: "
+                "need devices evenly split per process and divisible by "
+                "ici_tp; for multi-host-per-slice topologies build the "
+                "hybrid mesh explicitly with mesh_utils"
+            )
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_slice // ici_tp, ici_tp),
+            dcn_mesh_shape=(procs, 1),
+        )
+    else:
+        grid = mesh_utils.create_device_mesh((n // ici_tp, ici_tp))
+    return Mesh(grid, axis_names)

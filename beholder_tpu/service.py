@@ -17,8 +17,6 @@ preserved exactly:
 
 from __future__ import annotations
 
-import time
-
 from beholder_tpu import proto
 from beholder_tpu.clients import (
     EmbyClient,
@@ -251,9 +249,24 @@ def init(
 
 
 def main() -> None:  # pragma: no cover - process entrypoint
+    import signal
+    import threading
+
     service = init()
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        service.broker.close()
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    # graceful shutdown: stop consuming, drain pending analytics, close
+    service.logger.info("shutting down")
+    service.broker.close()
+    if service.analytics is not None:
+        try:
+            service.analytics.flush()
+            service.analytics.drain()
+        except Exception:  # noqa: BLE001 - best effort on the way out
+            pass
+    service.metrics.close()
+    service.db.close()
+
+

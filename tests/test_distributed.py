@@ -1,0 +1,22 @@
+"""Multi-host helpers: the single-process paths testable on one host."""
+
+import jax
+import pytest
+
+from beholder_tpu.parallel import initialize, make_hybrid_mesh
+
+
+def test_initialize_is_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR", raising=False)
+    initialize()  # must not raise or touch jax.distributed
+
+
+def test_hybrid_mesh_single_process_shape():
+    mesh = make_hybrid_mesh(ici_tp=2)
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (len(jax.devices()) // 2, 2)
+
+
+def test_hybrid_mesh_validates_divisibility():
+    with pytest.raises(ValueError, match="does not divide"):
+        make_hybrid_mesh(ici_tp=3)
