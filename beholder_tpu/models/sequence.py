@@ -26,6 +26,7 @@ from jax.sharding import Mesh
 
 from beholder_tpu.ops import NUM_STATUSES
 from beholder_tpu.ops.attention import full_attention, ring_attention
+from beholder_tpu.ops.moe import SwitchFFN
 
 from .train import TrainState, apply_gradients
 
@@ -37,6 +38,8 @@ class Block(nn.Module):
     heads: int
     attention: str = "full"  # "full" | "ring"
     mesh: Mesh | None = None
+    ffn: str = "dense"  # "dense" | "moe"
+    num_experts: int = 4
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -59,9 +62,12 @@ class Block(nn.Module):
         x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
 
         y = nn.LayerNorm()(x)
-        y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
-        y = nn.gelu(y)
-        x = x + nn.Dense(d, name="down", dtype=jnp.bfloat16)(y).astype(x.dtype)
+        if self.ffn == "moe":
+            x = x + SwitchFFN(d, 4 * d, self.num_experts, name="moe")(y)
+        else:
+            y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
+            y = nn.gelu(y)
+            x = x + nn.Dense(d, name="down", dtype=jnp.bfloat16)(y).astype(x.dtype)
         return x
 
 
@@ -73,6 +79,8 @@ class TelemetrySequenceModel(nn.Module):
     layers: int = 2
     attention: str = "full"
     mesh: Mesh | None = None
+    ffn: str = "dense"  # "dense" | "moe" (Switch top-1, ep-shardable)
+    num_experts: int = 4
 
     @nn.compact
     def __call__(self, feats: jax.Array) -> jax.Array:
@@ -84,6 +92,8 @@ class TelemetrySequenceModel(nn.Module):
                 self.heads,
                 attention=self.attention,
                 mesh=self.mesh,
+                ffn=self.ffn,
+                num_experts=self.num_experts,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm()(x)
@@ -105,11 +115,18 @@ def stream_features(progress: jax.Array, statuses: jax.Array) -> tuple[jax.Array
     return feats, targets
 
 
+AUX_LOSS_WEIGHT = 0.01  # standard Switch load-balance coefficient
+
+
 def seq_loss(model: TelemetrySequenceModel, params, feats, targets) -> jax.Array:
-    pred = model.apply(params, feats)
+    pred, sown = model.apply(params, feats, mutable="intermediates")
     err = (pred - targets) ** 2
     mask = jnp.ones_like(err).at[:, -1].set(0.0)  # last target is padding
-    return (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    loss = (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    # MoE blocks sow per-layer load-balance losses; dense models sow nothing
+    for aux in jax.tree.leaves(sown):
+        loss = loss + AUX_LOSS_WEIGHT * aux
+    return loss
 
 
 def init_seq_state(
@@ -119,7 +136,10 @@ def init_seq_state(
     learning_rate: float = 1e-3,
 ) -> tuple[TrainState, optax.GradientTransformation, TelemetrySequenceModel]:
     model = model or TelemetrySequenceModel()
-    params = model.init(rng, jnp.zeros((1, seq_len, FEATURES)))
+    variables = model.init(rng, jnp.zeros((1, seq_len, FEATURES)))
+    # MoE blocks sow an "intermediates" collection during init; only the
+    # trainable params belong in the train state
+    params = {"params": variables["params"]}
     tx = optax.adam(learning_rate)
     return TrainState(params, tx.init(params), jnp.int32(0)), tx, model
 

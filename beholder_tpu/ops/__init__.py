@@ -11,6 +11,7 @@ reference; parity components live in the sibling packages.
 """
 
 from .aggregate import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
+from .moe import SwitchFFN, expert_shardings, expert_specs
 from .pallas_aggregate import aggregate_telemetry_pallas
 
 __all__ = [
@@ -19,4 +20,7 @@ __all__ = [
     "aggregate_telemetry_pallas",
     "status_counts",
     "ewma",
+    "SwitchFFN",
+    "expert_shardings",
+    "expert_specs",
 ]

@@ -1,0 +1,125 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism (``ep``).
+
+EXTENSION BEYOND THE REFERENCE (which has no models or tensors of any kind
+— SURVEY.md §0/§5). Adds sparse capacity to the sequence models in
+:mod:`beholder_tpu.models.sequence`.
+
+TPU-first design notes:
+
+- Routing is top-1 (Switch) with a fixed per-expert capacity, so every
+  shape is static: dispatch and combine are dense one-hot tensors and the
+  expert compute is three einsums — all MXU work, no gather/scatter, no
+  data-dependent shapes for XLA to choke on.
+- Expert weights carry a leading expert dim sharded ``P("ep", ...)``; the
+  dispatch einsum contracts tokens against that dim, so GSPMD lowers the
+  exchange to an all-to-all over the ``ep`` axis (ICI on hardware).
+- Expert matmuls run in bfloat16 with float32 router/combine math.
+- The standard load-balance auxiliary loss is sown into the
+  ``intermediates`` collection; training code picks it up via
+  ``mutable="intermediates"`` (see ``seq_loss``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from beholder_tpu.parallel.sharding import (
+    leading_axis_spec,
+    path_key_names,
+    path_specs,
+    shardings_from_specs,
+)
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 routed mixture-of-experts feed-forward block.
+
+    (B, T, D) -> (B, T, D). Tokens beyond an expert's capacity are dropped
+    (contribute zero), as in Switch Transformers; the residual connection
+    around the block carries them through unchanged.
+    """
+
+    dim: int
+    ff_dim: int
+    num_experts: int
+    capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, t, d = x.shape
+        n = b * t
+        e = self.num_experts
+        cap = max(1, int(self.capacity_factor * n / e))
+        xf = x.reshape(n, d)
+
+        logits = nn.Dense(e, name="router", dtype=jnp.float32)(
+            xf.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+        gate = jnp.max(probs, axis=-1)  # (N,)
+        choice = jnp.argmax(probs, axis=-1)  # (N,)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (N, E)
+
+        # queue position of each token within its chosen expert; -1 where
+        # the token did not choose that expert (one_hot of -1 is all-zero)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+        within_cap = (pos >= 0.0) & (pos < cap)
+        dispatch = (
+            jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+            * within_cap[..., None]
+        )  # (N, E, C)
+        combine = dispatch * gate[:, None, None]
+
+        w_up = self.param(
+            "expert_up", nn.initializers.lecun_normal(), (e, d, self.ff_dim)
+        )
+        b_up = self.param("expert_up_bias", nn.initializers.zeros, (e, self.ff_dim))
+        w_down = self.param(
+            "expert_down", nn.initializers.lecun_normal(), (e, self.ff_dim, d)
+        )
+        b_down = self.param("expert_down_bias", nn.initializers.zeros, (e, d))
+
+        xin = jnp.einsum("nec,nd->ecd", dispatch, xf.astype(jnp.float32))
+        h = jnp.einsum(
+            "ecd,edf->ecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
+        ).astype(jnp.float32) + b_up[:, None, :]
+        h = jax.nn.gelu(h)
+        out = jnp.einsum(
+            "ecf,efd->ecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
+        ).astype(jnp.float32) + b_down[:, None, :]
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+
+        # Switch load-balance loss: E * sum_e f_e * p_e, minimized (=1) at
+        # uniform routing; scaled in by the training loss, not here
+        frac_tokens = onehot.mean(axis=0)
+        frac_probs = probs.mean(axis=0)
+        aux = e * jnp.sum(frac_tokens * frac_probs)
+        self.sow("intermediates", "aux_loss", aux)
+
+        return y.reshape(b, t, d).astype(x.dtype)
+
+
+def _is_expert_path(path: tuple) -> bool:
+    return any(name.startswith("expert_") for name in path_key_names(path))
+
+
+def expert_specs(tree: Any, axis: str = "ep") -> Any:
+    """PartitionSpec pytree: expert-stacked leaves on ``axis``, rest
+    replicated. Works for params and for optimizer states that mirror the
+    param tree (optax moments keep the leaf paths)."""
+    return path_specs(
+        tree,
+        lambda path, leaf: (
+            leading_axis_spec(leaf, axis) if _is_expert_path(path) else P()
+        ),
+    )
+
+
+def expert_shardings(tree: Any, mesh: Mesh, axis: str = "ep") -> Any:
+    """NamedSharding pytree for :func:`expert_specs` on ``mesh``."""
+    return shardings_from_specs(expert_specs(tree, axis), mesh)

@@ -15,6 +15,13 @@ from .mesh import (
     replicated,
     sharded_train_step,
 )
+from .pipeline import (
+    merge_microbatches,
+    pipeline_forward,
+    split_microbatches,
+    stack_stage_params,
+    stage_shardings,
+)
 
 __all__ = [
     "make_mesh",
@@ -24,4 +31,9 @@ __all__ = [
     "sharded_train_step",
     "initialize",
     "make_hybrid_mesh",
+    "pipeline_forward",
+    "stack_stage_params",
+    "stage_shardings",
+    "split_microbatches",
+    "merge_microbatches",
 ]

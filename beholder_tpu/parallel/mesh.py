@@ -24,6 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .sharding import path_key_names, path_specs, shardings_from_specs
+
 
 def make_mesh(n_devices: int | None = None, tp: int | None = None) -> Mesh:
     """A 2-D ("dp", "tp") mesh over the first ``n_devices`` devices.
@@ -50,26 +52,22 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp"))
 
 
-def _spec_for(path: tuple, leaf: Any, mesh: Mesh) -> NamedSharding:
-    names = {str(getattr(p, "key", getattr(p, "name", ""))) for p in path}
+def _spec_for(path: tuple, leaf: Any) -> P:
+    names = path_key_names(path)
     ndim = getattr(leaf, "ndim", 0)
     if "in_proj" in names and ndim == 2:
-        spec = P(None, "tp")
-    elif "in_proj" in names and ndim == 1:
-        spec = P("tp")
-    elif "mid_proj" in names and ndim == 2:
-        spec = P("tp", None)
-    else:
-        spec = P()
-    return NamedSharding(mesh, spec)
+        return P(None, "tp")
+    if "in_proj" in names and ndim == 1:
+        return P("tp")
+    if "mid_proj" in names and ndim == 2:
+        return P("tp", None)
+    return P()
 
 
 def state_shardings(state: Any, mesh: Mesh) -> Any:
     """Sharding pytree for a whole TrainState (params + optimizer moments +
     step), derived from leaf paths."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: _spec_for(path, leaf, mesh), state
-    )
+    return shardings_from_specs(path_specs(state, _spec_for), mesh)
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:

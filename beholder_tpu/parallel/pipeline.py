@@ -1,0 +1,122 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+EXTENSION BEYOND THE REFERENCE (tritonmedia/beholder has no parallelism of
+any kind — SURVEY.md §2 lists every strategy as absent; the reference is a
+single-threaded Node event loop, /root/reference/index.js:1-160).
+
+The TPU-idiomatic shape of pipeline parallelism:
+
+- Per-stage parameters are stacked along a new leading "stage" axis and
+  sharded ``P("pp", ...)`` — each device materializes only its own stage's
+  weights, so an S-stage model needs 1/S of the parameter memory per chip.
+- Activations flow around the ring with ``ppermute`` (riding ICI on real
+  hardware). The schedule is the classic GPipe fill-and-drain: with M
+  microbatches and S stages, M + S - 1 ticks run, every device executing
+  the *same* program (its stage fn on its resident weights) each tick —
+  no data-dependent control flow, one ``lax.scan``, fully jittable and
+  differentiable (grads flow back through the ``ppermute`` transposes).
+- Bubble fraction is (S-1)/(M+S-1); callers pick M >> S to amortize.
+
+The same program runs on the virtual CPU test mesh and a TPU pod slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .sharding import leading_axis_spec, shardings_from_specs
+
+
+def stack_stage_params(stage_params: list[Any]) -> Any:
+    """Stack S per-stage param pytrees along a new leading stage axis.
+
+    All stages must be homotypic (same tree structure and leaf shapes) —
+    the uniform-block transformer case pipeline parallelism is built for.
+    """
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+
+
+def stage_specs(stacked: Any, axis: str = "pp") -> Any:
+    """PartitionSpec pytree placing each leaf's leading (stage) dim on
+    ``axis`` and leaving the rest replicated."""
+    return jax.tree.map(lambda leaf: leading_axis_spec(leaf, axis), stacked)
+
+
+def stage_shardings(stacked: Any, mesh: Mesh, axis: str = "pp") -> Any:
+    """NamedSharding pytree for :func:`stage_specs` on ``mesh``."""
+    return shardings_from_specs(stage_specs(stacked, axis), mesh)
+
+
+def split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """(B, ...) -> (M, B/M, ...) microbatch stack for :func:`pipeline_forward`."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches={num_microbatches}"
+        )
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def merge_microbatches(x: jax.Array) -> jax.Array:
+    """Inverse of :func:`split_microbatches`: (M, Bm, ...) -> (M*Bm, ...)."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+
+
+def pipeline_forward(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run microbatches through S = ``mesh.shape[axis]`` pipeline stages.
+
+    ``stage_fn(params, x) -> y`` must preserve shape and dtype (uniform
+    stages). ``stacked_params`` leaves carry a leading stage dim of size S
+    (see :func:`stack_stage_params`); ``x`` is an (M, Bm, ...) microbatch
+    stack. Returns the (M, Bm, ...) outputs of the final stage, replicated,
+    equal to applying the S stages in sequence.
+    """
+    s = mesh.shape[axis]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                f"stage leaf has leading dim {leaf.shape[0]}, mesh {axis}={s}"
+            )
+
+    def local(params_l: Any, xs: jax.Array) -> jax.Array:
+        # each device sees a single stage's slice (leading dim 1)
+        params = jax.tree.map(lambda leaf: leaf[0], params_l)
+        idx = jax.lax.axis_index(axis)
+        if s > 1:
+            pad = jnp.zeros((s - 1, *xs.shape[1:]), xs.dtype)
+            feed = jnp.concatenate([xs, pad])
+        else:
+            feed = xs
+        ring = [(j, (j + 1) % s) for j in range(s)]
+
+        def tick(state: jax.Array, inp: jax.Array):
+            # stage 0 ingests the next microbatch; later stages keep the
+            # activation ppermute delivered last tick
+            state = jnp.where(idx == 0, inp, state)
+            out = stage_fn(params, state)
+            nxt = jax.lax.ppermute(out, axis, ring) if s > 1 else out
+            return nxt, out
+
+        _, ys = jax.lax.scan(tick, jnp.zeros_like(xs[0]), feed)
+        # tick t on the last stage completes microbatch t-(S-1)
+        done = ys[s - 1 :]
+        keep = jnp.where(idx == s - 1, jnp.ones((), done.dtype), 0)
+        return jax.lax.psum(done * keep, axis)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(stage_specs(stacked_params, axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x)

@@ -1,0 +1,44 @@
+"""Shared pytree → PartitionSpec/NamedSharding utilities.
+
+Every sharding scheme in this package follows the same two-step shape:
+derive a PartitionSpec per leaf (from its path or its leading dim), then
+wrap each spec in ``NamedSharding(mesh, spec)``. The wrap step lives here
+once so schemes (tp/ep/pp/…) only define their spec rule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def leading_axis_spec(leaf: Any, axis: str) -> P:
+    """P(axis, None, ...) over the leaf's leading dim; replicated for
+    scalars (a 0-d leaf has no dim to shard)."""
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim < 1:
+        return P()
+    return P(axis, *([None] * (ndim - 1)))
+
+
+def path_specs(
+    tree: Any, rule: Callable[[tuple, Any], P]
+) -> Any:
+    """PartitionSpec pytree from a ``rule(path, leaf) -> P`` mapping."""
+    return jax.tree_util.tree_map_with_path(rule, tree)
+
+
+def shardings_from_specs(specs: Any, mesh: Mesh) -> Any:
+    """Wrap every PartitionSpec leaf in ``NamedSharding(mesh, spec)``."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def path_key_names(path: tuple) -> set[str]:
+    """The string key/name of every entry on a pytree path."""
+    return {str(getattr(p, "key", getattr(p, "name", ""))) for p in path}
