@@ -26,6 +26,10 @@ class InMemoryBroker(Broker):
     def __init__(self, prefetch: int = 100):
         self.prefetch = prefetch
         self._topics: dict[str, _Topic] = {}
+        #: (topic, entry) pairs that have a handler — the only topics
+        #: _dispatch can make progress on; kept separate so the hot loop
+        #: never scans consumer-less topics
+        self._consumers: list[tuple[str, _Topic]] = []
         self._unacked: dict[int, tuple[str, bytes]] = {}
         self._next_tag = 1
         self._connected = False
@@ -44,6 +48,7 @@ class InMemoryBroker(Broker):
         if entry.handler is not None:
             raise ValueError(f"topic {topic!r} already has a consumer")
         entry.handler = handler
+        self._consumers.append((topic, entry))
         self._dispatch()
 
     def publish(self, topic: str, body: bytes) -> None:
@@ -67,21 +72,23 @@ class InMemoryBroker(Broker):
         if self._dispatching or not self._connected:
             return  # ack() inside a handler re-enters; the outer loop continues
         self._dispatching = True
+        unacked = self._unacked
+        prefetch = self.prefetch
         try:
             progressed = True
-            while progressed and len(self._unacked) < self.prefetch:
+            while progressed and len(unacked) < prefetch:
                 progressed = False
-                # snapshot: a handler may publish to a brand-new topic,
-                # mutating self._topics mid-iteration
-                for topic, entry in list(self._topics.items()):
-                    if len(self._unacked) >= self.prefetch:
+                # snapshot: a handler may listen() on a brand-new topic,
+                # mutating self._consumers mid-iteration
+                for topic, entry in tuple(self._consumers):
+                    if len(unacked) >= prefetch:
                         break
-                    if entry.handler is None or not entry.pending:
+                    if not entry.pending:
                         continue
                     body, redelivered = entry.pending.popleft()
                     tag = self._next_tag
                     self._next_tag += 1
-                    self._unacked[tag] = (topic, body)
+                    unacked[tag] = (topic, body)
                     delivery = Delivery(
                         topic, body, tag, self._settle, redelivered=redelivered
                     )

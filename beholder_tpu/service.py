@@ -93,6 +93,15 @@ class BeholderService:
         self._progress_proto = proto.load("api.TelemetryProgress")
         proto.load("api.Media")  # parity with index.js:48
 
+        # enum constants resolved once; the names are compile-time literals
+        # in the reference too (index.js:94,142)
+        self._deployed_status = proto.string_to_enum(
+            self._status_proto, "TelemetryStatusEntry", "DEPLOYED"
+        )
+        self._creator_trello = proto.string_to_enum(
+            proto.Media, "CreatorType", "TRELLO"
+        )
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Register both consumers (index.js:62,127) and log 'initialized'."""
@@ -104,7 +113,7 @@ class BeholderService:
     # -- helpers -----------------------------------------------------------
     def comment(self, card_id: str, text: str) -> None:
         """Comment on a Trello card + count it (index.js:50-58)."""
-        self.logger.info(f"creating comment on {card_id} with text: {text}")
+        self.logger.info("creating comment on %s with text: %s", card_id, text)
         self.trello.comment_card(card_id, text)
         self.metrics.trello_comments_total.inc()
 
@@ -115,7 +124,7 @@ class BeholderService:
         media_id, status = msg.mediaId, msg.status
 
         self.logger.info(
-            f"processing status update for media {media_id}, status: {status}"
+            "processing status update for media %s, status: %s", media_id, status
         )
 
         self.db.update_status(media_id, status)
@@ -133,7 +142,7 @@ class BeholderService:
             list_pointer = self.flow_ids.get(status_text.lower())
             if list_pointer:
                 self.logger.info(
-                    f"moving media card {media_id} (card id {media.creatorId})"
+                    "moving media card %s (card id %s)", media_id, media.creatorId
                 )
                 self.trello.move_card(media.creatorId, list_pointer, pos=2)
             else:
@@ -144,13 +153,10 @@ class BeholderService:
 
         # deployed hooks — failures swallowed (index.js:92-122)
         try:
-            deployed = proto.string_to_enum(
-                self._status_proto, "TelemetryStatusEntry", "DEPLOYED"
-            )
-            if media.status == deployed:
+            if media.status == self._deployed_status:
                 if self._telegram_enabled:
                     self.logger.info(
-                        f"informing telegram that media '{media_id}' is available"
+                        "informing telegram that media '%s' is available", media_id
                     )
                     self.telegram.notify_deployed(
                         self._telegram_channel, media.name, media.metadataId
@@ -158,7 +164,7 @@ class BeholderService:
 
                 if self._emby_enabled:
                     self.logger.info(
-                        f"telling emby to refresh at {self._emby_host}"
+                        "telling emby to refresh at %s", self._emby_host
                     )
                     self.emby.refresh_library()
         except Exception as err:  # noqa: BLE001 - parity with index.js:120-122
@@ -174,8 +180,10 @@ class BeholderService:
             progress, host = msg.progress, msg.host
 
             self.logger.info(
-                f"processing progress update on media {media_id} "
-                f"status {status} percent {progress}"
+                "processing progress update on media %s status %s percent %s",
+                media_id,
+                status,
+                progress,
             )
             status_text = proto.enum_to_string(
                 self._progress_proto, "TelemetryStatusEntry", status
@@ -203,9 +211,7 @@ class BeholderService:
 
             media = self.db.get_by_id(media_id)
 
-            if media.creator == proto.string_to_enum(
-                proto.Media, "CreatorType", "TRELLO"
-            ):
+            if media.creator == self._creator_trello:
                 comment_text = f"{status_text}: Progress **{progress}%**"
                 if host:
                     comment_text += f" (_{host}_)"
