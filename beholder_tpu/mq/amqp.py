@@ -70,7 +70,7 @@ class _Protocol(asyncio.Protocol):
         self._hb_task: asyncio.Task | None = None
         self._last_rx = asyncio.get_event_loop().time()
         # in-progress delivery: (consumer_tag, delivery_tag, redelivered,
-        # routing_key, expected_size, chunks)
+        # routing_key, expected_size, chunks, headers)
         self._pending: list | None = None
         self._log = client._log
 
@@ -108,10 +108,9 @@ class _Protocol(asyncio.Protocol):
             self._on_method(frame)
         elif frame.type == codec.FRAME_HEADER:
             if self._pending is not None:
-                reader = codec.Reader(frame.payload)
-                reader.short()  # class id
-                reader.short()  # weight
-                self._pending[4] = reader.longlong()  # body size
+                size, headers = codec.parse_basic_header(frame.payload)
+                self._pending[4] = size
+                self._pending[6] = headers
                 self._maybe_complete()
         elif frame.type == codec.FRAME_BODY:
             if self._pending is not None:
@@ -178,7 +177,7 @@ class _Protocol(asyncio.Protocol):
             redelivered = bool(reader.octet() & 1)
             reader.shortstr()  # exchange
             routing_key = reader.shortstr()
-            self._pending = [consumer_tag, delivery_tag, redelivered, routing_key, None, []]
+            self._pending = [consumer_tag, delivery_tag, redelivered, routing_key, None, [], {}]
         elif cm == codec.CONNECTION_CLOSE:
             code = reader.short()
             text = reader.shortstr()
@@ -204,8 +203,8 @@ class _Protocol(asyncio.Protocol):
         if len(body) < pending[4]:
             return
         self._pending = None
-        _tag, delivery_tag, redelivered, routing_key, _size, _chunks = pending
-        self.client._on_deliver(routing_key, body, delivery_tag, redelivered)
+        _tag, delivery_tag, redelivered, routing_key, _size, _chunks, headers = pending
+        self.client._on_deliver(routing_key, body, delivery_tag, redelivered, headers)
 
     async def _heartbeats(self) -> None:
         """Send heartbeats at interval/2; drop the connection if the peer
@@ -253,14 +252,20 @@ class _Protocol(asyncio.Protocol):
         )
         self._send_method(1, codec.BASIC_CONSUME, consume)
 
-    def publish(self, routing_key: str, body: bytes) -> None:
+    def publish(
+        self, routing_key: str, body: bytes, headers: dict | None = None
+    ) -> None:
         assert self.transport is not None
         args = (
             codec.Writer().short(0).shortstr("").shortstr(routing_key).bits(False, False).getvalue()
         )
         out = bytearray(codec.method_frame(1, codec.BASIC_PUBLISH, args).serialize())
         out += codec.header_frame(
-            1, codec.CLASS_BASIC, len(body), delivery_mode=codec.DELIVERY_PERSISTENT
+            1,
+            codec.CLASS_BASIC,
+            len(body),
+            delivery_mode=codec.DELIVERY_PERSISTENT,
+            headers=headers,
         ).serialize()
         for bf in codec.body_frames(1, body, self.frame_max):
             out += bf.serialize()
@@ -338,16 +343,16 @@ class AmqpBroker(Broker):
         self._handlers[topic] = handler
         self._call_on_loop(lambda p: p.declare_and_consume(topic))
 
-    def publish(self, topic: str, body: bytes) -> None:
+    def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
         payload = bytes(body)
 
         def _publish_or_buffer():
             if self._protocol is not None:
-                self._protocol.publish(topic, payload)
+                self._protocol.publish(topic, payload, headers)
             elif len(self._publish_buffer) < self.MAX_BUFFERED_PUBLISHES:
                 # disconnected: hold the message until reconnect, like the
                 # reference stack's amqp-connection-manager does
-                self._publish_buffer.append((topic, payload))
+                self._publish_buffer.append((topic, payload, headers))
             else:
                 self._log.warning(
                     f"publish buffer full ({self.MAX_BUFFERED_PUBLISHES}); "
@@ -403,8 +408,8 @@ class AmqpBroker(Broker):
                     for topic in self._handlers:
                         protocol.declare_and_consume(topic)
                     buffered, self._publish_buffer = self._publish_buffer, []
-                    for topic, body in buffered:
-                        protocol.publish(topic, body)
+                    for topic, body, headers in buffered:
+                        protocol.publish(topic, body, headers)
                     if buffered:
                         self._log.info(
                             f"flushed {len(buffered)} buffered publishes"
@@ -449,7 +454,12 @@ class AmqpBroker(Broker):
 
     # -- delivery dispatch --------------------------------------------------
     def _on_deliver(
-        self, topic: str, body: bytes, delivery_tag: int, redelivered: bool
+        self,
+        topic: str,
+        body: bytes,
+        delivery_tag: int,
+        redelivered: bool,
+        headers: dict | None = None,
     ) -> None:
         protocol = self._protocol
         loop = self._loop
@@ -458,7 +468,9 @@ class AmqpBroker(Broker):
             if loop is not None and protocol is not None:
                 loop.call_soon_threadsafe(protocol.settle, tag, acked, requeue)
 
-        delivery = Delivery(topic, body, delivery_tag, settle, redelivered)
+        delivery = Delivery(
+            topic, body, delivery_tag, settle, redelivered, headers=headers
+        )
         self._dispatch_q.put(delivery)
 
     def _run_dispatch(self) -> None:

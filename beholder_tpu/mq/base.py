@@ -23,7 +23,7 @@ Handler = Callable[["Delivery"], None]
 class Delivery:
     """One message handed to a consumer."""
 
-    __slots__ = ("topic", "body", "delivery_tag", "redelivered", "_settle")
+    __slots__ = ("topic", "body", "delivery_tag", "redelivered", "headers", "_settle")
 
     def __init__(
         self,
@@ -32,11 +32,14 @@ class Delivery:
         delivery_tag: int,
         settle: Callable[[int, bool, bool], None],
         redelivered: bool = False,
+        headers: dict | None = None,
     ):
         self.topic = topic
         self.body = body
         self.delivery_tag = delivery_tag
         self.redelivered = redelivered
+        #: AMQP basic-properties headers table (trace context rides here)
+        self.headers = headers or {}
         #: settle(delivery_tag, acked, requeue) — exactly-once per delivery.
         self._settle = settle
 
@@ -73,8 +76,11 @@ class Broker(abc.ABC):
         """Subscribe ``handler`` to ``topic`` (index.js:62,127)."""
 
     @abc.abstractmethod
-    def publish(self, topic: str, body: bytes) -> None:
-        """Publish a message (producer side; used by tests/tools/bench)."""
+    def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
+        """Publish a message (producer side; used by tests/tools/bench).
+
+        ``headers`` ride the AMQP basic-properties headers table — used for
+        trace-context propagation, never required by consumers."""
 
     @abc.abstractmethod
     def close(self) -> None:

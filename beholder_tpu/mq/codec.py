@@ -119,7 +119,14 @@ class Writer:
         if isinstance(value, bool):
             self._parts.append(b"t" + struct.pack(">B", int(value)))
         elif isinstance(value, int):
-            self._parts.append(b"I" + struct.pack(">i", value))
+            if -(1 << 31) <= value < (1 << 31):
+                self._parts.append(b"I" + struct.pack(">i", value))
+            elif -(1 << 63) <= value < (1 << 63):
+                self._parts.append(b"l" + struct.pack(">q", value))
+            else:
+                raise ProtocolError(f"int too large for AMQP field: {value}")
+        elif isinstance(value, float):
+            self._parts.append(b"d" + struct.pack(">d", value))
         elif isinstance(value, str):
             raw = value.encode("utf-8")
             self._parts.append(b"S" + struct.pack(">I", len(raw)) + raw)
@@ -179,15 +186,46 @@ class Reader:
         return out
 
     def _field_value(self) -> Any:
+        # the full RabbitMQ field-type set: peers and the broker itself
+        # attach headers (x-death on dead-lettered messages carries arrays
+        # and timestamps), so the consume path must read all of them
         kind = self._take(1)
         if kind == b"t":
             return bool(self.octet())
+        if kind == b"b":
+            return struct.unpack(">b", self._take(1))[0]
+        if kind == b"B":
+            return self.octet()
+        if kind == b"s":
+            return struct.unpack(">h", self._take(2))[0]
+        if kind == b"u":
+            return self.short()
         if kind == b"I":
             return struct.unpack(">i", self._take(4))[0]
+        if kind == b"i":
+            return self.long()
         if kind == b"l":
             return struct.unpack(">q", self._take(8))[0]
+        if kind == b"f":
+            return struct.unpack(">f", self._take(4))[0]
+        if kind == b"d":
+            return struct.unpack(">d", self._take(8))[0]
+        if kind == b"D":  # decimal: scale octet + int32 value
+            scale = self.octet()
+            return struct.unpack(">i", self._take(4))[0] / (10**scale)
         if kind == b"S":
             return self.longstr().decode("utf-8", "replace")
+        if kind == b"x":
+            return self.longstr()
+        if kind == b"A":
+            payload = self.longstr()
+            sub = Reader(payload)
+            items = []
+            while sub._pos < len(sub._data):
+                items.append(sub._field_value())
+            return items
+        if kind == b"T":
+            return struct.unpack(">Q", self._take(8))[0]
         if kind == b"F":
             return self.table()
         if kind == b"V":
@@ -223,22 +261,65 @@ def method_frame(channel: int, class_method: tuple[int, int], args: bytes = b"")
     return Frame(FRAME_METHOD, channel, struct.pack(">HH", cid, mid) + args)
 
 
-#: basic-properties flag bit for delivery-mode (AMQP 0-9-1 §4.2.6.1)
+#: basic-properties flag bits (AMQP 0-9-1 §4.2.6.1); properties are
+#: serialized in descending flag-bit order
+_FLAG_CONTENT_TYPE = 1 << 15
+_FLAG_CONTENT_ENCODING = 1 << 14
+_FLAG_HEADERS = 1 << 13
 _FLAG_DELIVERY_MODE = 1 << 12
 DELIVERY_PERSISTENT = 2
 
 
 def header_frame(
-    channel: int, class_id: int, body_size: int, delivery_mode: int | None = None
+    channel: int,
+    class_id: int,
+    body_size: int,
+    delivery_mode: int | None = None,
+    headers: dict[str, Any] | None = None,
 ) -> Frame:
-    # weight=0; the only basic property the beholder path sets is
-    # delivery-mode=2 so messages survive a broker restart alongside the
-    # durable queues they sit in
-    flags = _FLAG_DELIVERY_MODE if delivery_mode is not None else 0
-    payload = struct.pack(">HHQH", class_id, 0, body_size, flags)
+    # weight=0; the beholder path sets delivery-mode=2 so messages survive
+    # a broker restart alongside the durable queues they sit in, and an
+    # optional headers table (trace-context propagation)
+    flags = 0
+    props = Writer()
+    if headers:
+        flags |= _FLAG_HEADERS
+        props.table(headers)
     if delivery_mode is not None:
-        payload += struct.pack(">B", delivery_mode)
+        flags |= _FLAG_DELIVERY_MODE
+        props.octet(delivery_mode)
+    payload = (
+        struct.pack(">HHQH", class_id, 0, body_size, flags) + props.getvalue()
+    )
     return Frame(FRAME_HEADER, channel, payload)
+
+
+def parse_basic_header(payload: bytes) -> tuple[int, dict[str, Any]]:
+    """Parse a content-header frame payload -> (body_size, headers table).
+
+    Decodes the property subset peers may send ahead of the headers table
+    (content-type/encoding) so the table offset is right; properties after
+    delivery-mode are ignored — nothing downstream reads them.
+    """
+    reader = Reader(payload)
+    reader.short()  # class id
+    reader.short()  # weight
+    body_size = reader.longlong()
+    flags = reader.short()
+    if flags & _FLAG_CONTENT_TYPE:
+        reader.shortstr()
+    if flags & _FLAG_CONTENT_ENCODING:
+        reader.shortstr()
+    headers: dict[str, Any] = {}
+    if flags & _FLAG_HEADERS:
+        try:
+            headers = reader.table()
+        except ProtocolError:
+            # headers are optional metadata; a table with a field type from
+            # a future spec revision must not kill the connection (the body
+            # size above is already parsed, so delivery proceeds)
+            headers = {}
+    return body_size, headers
 
 
 def body_frames(channel: int, body: bytes, frame_max: int) -> list[Frame]:

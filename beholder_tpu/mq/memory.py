@@ -19,7 +19,7 @@ from .base import Broker, Delivery, Handler
 @dataclass
 class _Topic:
     handler: Handler | None = None
-    pending: deque = field(default_factory=deque)  # (body, redelivered)
+    pending: deque = field(default_factory=deque)  # (body, redelivered, headers)
 
 
 class InMemoryBroker(Broker):
@@ -30,7 +30,7 @@ class InMemoryBroker(Broker):
         #: _dispatch can make progress on; kept separate so the hot loop
         #: never scans consumer-less topics
         self._consumers: list[tuple[str, _Topic]] = []
-        self._unacked: dict[int, tuple[str, bytes]] = {}
+        self._unacked: dict[int, tuple[str, bytes, dict | None]] = {}
         self._next_tag = 1
         self._connected = False
         self._dispatching = False
@@ -51,8 +51,10 @@ class InMemoryBroker(Broker):
         self._consumers.append((topic, entry))
         self._dispatch()
 
-    def publish(self, topic: str, body: bytes) -> None:
-        self._topics.setdefault(topic, _Topic()).pending.append((bytes(body), False))
+    def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
+        self._topics.setdefault(topic, _Topic()).pending.append(
+            (bytes(body), False, headers)
+        )
         if self._connected:
             self._dispatch()
 
@@ -85,12 +87,17 @@ class InMemoryBroker(Broker):
                         break
                     if not entry.pending:
                         continue
-                    body, redelivered = entry.pending.popleft()
+                    body, redelivered, headers = entry.pending.popleft()
                     tag = self._next_tag
                     self._next_tag += 1
-                    unacked[tag] = (topic, body)
+                    unacked[tag] = (topic, body, headers)
                     delivery = Delivery(
-                        topic, body, tag, self._settle, redelivered=redelivered
+                        topic,
+                        body,
+                        tag,
+                        self._settle,
+                        redelivered=redelivered,
+                        headers=headers,
                     )
                     progressed = True
                     try:
@@ -107,9 +114,9 @@ class InMemoryBroker(Broker):
             self._dispatching = False
 
     def _settle(self, tag: int, acked: bool, requeue: bool) -> None:
-        topic, body = self._unacked.pop(tag)
+        topic, body, headers = self._unacked.pop(tag)
         if not acked and requeue:
-            self._topics[topic].pending.appendleft((body, True))
+            self._topics[topic].pending.appendleft((body, True, headers))
         # a freed prefetch slot (or a requeue) may unblock pending work;
         # re-entrant calls return immediately and the outer loop continues
         self._dispatch()

@@ -33,10 +33,10 @@ class _Conn(asyncio.Protocol):
         self.transport: asyncio.Transport | None = None
         self.saw_header = False
         self.prefetch = 0  # 0 = unlimited
-        self.unacked: dict[int, tuple[str, bytes]] = {}
+        self.unacked: dict[int, tuple[str, bytes, dict]] = {}
         self.consumes: dict[str, str] = {}  # queue -> consumer tag
         self.next_tag = 1
-        # in-flight publish: [routing_key, expected_size, chunks]
+        # in-flight publish: [routing_key, expected_size, chunks, headers]
         self._pending: list | None = None
         self._hb_task: asyncio.Task | None = None
         self._log = server._log
@@ -51,8 +51,10 @@ class _Conn(asyncio.Protocol):
             self._hb_task.cancel()
         self.server.conns.discard(self)
         # requeue unacked at the front, flagged redelivered (RabbitMQ behavior)
-        for _tag, (queue, body) in sorted(self.unacked.items(), reverse=True):
-            self.server.queues.setdefault(queue, deque()).appendleft((body, True))
+        for _tag, (queue, body, headers) in sorted(self.unacked.items(), reverse=True):
+            self.server.queues.setdefault(queue, deque()).appendleft(
+                (body, True, headers)
+            )
         self.unacked.clear()
         for queue in self.consumes:
             consumers = self.server.consumers.get(queue)
@@ -100,10 +102,9 @@ class _Conn(asyncio.Protocol):
         if frame.type == codec.FRAME_METHOD:
             self._on_method(frame)
         elif frame.type == codec.FRAME_HEADER and self._pending is not None:
-            reader = codec.Reader(frame.payload)
-            reader.short()
-            reader.short()
-            self._pending[1] = reader.longlong()
+            size, headers = codec.parse_basic_header(frame.payload)
+            self._pending[1] = size
+            self._pending[3] = headers
             self._maybe_complete_publish()
         elif frame.type == codec.FRAME_BODY and self._pending is not None:
             self._pending[2].append(frame.payload)
@@ -184,7 +185,7 @@ class _Conn(asyncio.Protocol):
             reader.short()
             reader.shortstr()  # exchange ("" = default)
             routing_key = reader.shortstr()
-            self._pending = [routing_key, None, []]
+            self._pending = [routing_key, None, [], {}]
         elif cm == codec.BASIC_ACK:
             tag = reader.longlong()
             multiple = bool(reader.octet() & 1)
@@ -200,8 +201,10 @@ class _Conn(asyncio.Protocol):
             requeue = bool(flags & 2)
             entry = self.unacked.pop(tag, None)
             if entry is not None and requeue:
-                queue, body = entry
-                self.server.queues.setdefault(queue, deque()).appendleft((body, True))
+                queue, body, headers = entry
+                self.server.queues.setdefault(queue, deque()).appendleft(
+                    (body, True, headers)
+                )
             self.server.pump()
         elif cm == codec.CONNECTION_CLOSE:
             self._send_method(0, codec.CONNECTION_CLOSE_OK)
@@ -224,17 +227,21 @@ class _Conn(asyncio.Protocol):
         if len(body) < pending[1]:
             return
         self._pending = None
-        self.server.queues.setdefault(pending[0], deque()).append((body, False))
+        self.server.queues.setdefault(pending[0], deque()).append(
+            (body, False, pending[3])
+        )
         self.server.pump()
 
     # -- delivery -----------------------------------------------------------
     def can_take(self) -> bool:
         return self.prefetch == 0 or len(self.unacked) < self.prefetch
 
-    def deliver(self, queue: str, body: bytes, redelivered: bool) -> None:
+    def deliver(
+        self, queue: str, body: bytes, redelivered: bool, headers: dict
+    ) -> None:
         tag = self.next_tag
         self.next_tag += 1
-        self.unacked[tag] = (queue, body)
+        self.unacked[tag] = (queue, body, headers)
         args = (
             codec.Writer()
             .shortstr(self.consumes[queue])
@@ -245,7 +252,7 @@ class _Conn(asyncio.Protocol):
             .getvalue()
         )
         self._send_method(1, codec.BASIC_DELIVER, args)
-        self._send(codec.header_frame(1, codec.CLASS_BASIC, len(body)))
+        self._send(codec.header_frame(1, codec.CLASS_BASIC, len(body), headers=headers))
         for bf in codec.body_frames(1, body, codec_frame_max()):
             self._send(bf)
 
@@ -347,10 +354,10 @@ class AmqpTestServer:
                 c for c in self.consumers.get(queue, []) if c.can_take()
             ]
             while pending and consumers:
-                body, redelivered = pending.popleft()
+                body, redelivered, headers = pending.popleft()
                 idx = self._rr.get(queue, 0) % len(consumers)
                 self._rr[queue] = idx + 1
-                consumers[idx].deliver(queue, body, redelivered)
+                consumers[idx].deliver(queue, body, redelivered, headers)
                 consumers = [c for c in consumers if c.can_take()]
 
 

@@ -10,6 +10,11 @@ TPU-first design notes:
   shape is static: dispatch and combine are dense one-hot tensors and the
   expert compute is three einsums — all MXU work, no gather/scatter, no
   data-dependent shapes for XLA to choke on.
+- Routing is grouped (GShard-style): tokens are split into fixed-size
+  groups and capacity is enforced per group, so dispatch/combine are
+  (G, S, E, C) with C ∝ S/E — memory stays LINEAR in total tokens
+  instead of the quadratic (N, E, C) of ungrouped dense dispatch, which
+  matters for the long-context sequence models this layer plugs into.
 - Expert weights carry a leading expert dim sharded ``P("ep", ...)``; the
   dispatch einsum contracts tokens against that dim, so GSPMD lowers the
   exchange to an all-to-all over the ``ep`` axis (ICI on hardware).
@@ -48,32 +53,47 @@ class SwitchFFN(nn.Module):
     ff_dim: int
     num_experts: int
     capacity_factor: float = 2.0
+    #: tokens per routing group; capacity is enforced within each group so
+    #: dispatch memory is O(N·capacity_factor·group_size), linear in N
+    group_size: int = 1024
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, t, d = x.shape
         n = b * t
         e = self.num_experts
-        cap = max(1, int(self.capacity_factor * n / e))
+        # pad to a whole number of fixed-size groups (shapes are static at
+        # trace time). Padding rows are zeros appended AFTER every real
+        # token, so within the one partial group their cumsum queue
+        # positions come last — they can only take capacity slots real
+        # tokens left unused — and their output rows are sliced off below.
+        s = min(self.group_size, n)
+        g = -(-n // s)
+        n_pad = g * s
+        cap = max(1, int(self.capacity_factor * s / e))
         xf = x.reshape(n, d)
+        if n_pad != n:
+            xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
+        xg = xf.reshape(g, s, d)
 
         logits = nn.Dense(e, name="router", dtype=jnp.float32)(
-            xf.astype(jnp.float32)
+            xg.astype(jnp.float32)
         )
-        probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
-        gate = jnp.max(probs, axis=-1)  # (N,)
-        choice = jnp.argmax(probs, axis=-1)  # (N,)
-        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)  # (G, S, E)
+        gate = jnp.max(probs, axis=-1)  # (G, S)
+        choice = jnp.argmax(probs, axis=-1)  # (G, S)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (G, S, E)
 
-        # queue position of each token within its chosen expert; -1 where
-        # the token did not choose that expert (one_hot of -1 is all-zero)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+        # queue position of each token within its chosen expert's per-group
+        # queue; -1 where the token did not choose that expert (one_hot of
+        # -1 is all-zero)
+        pos = jnp.cumsum(onehot, axis=1) * onehot - 1.0
         within_cap = (pos >= 0.0) & (pos < cap)
         dispatch = (
             jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
             * within_cap[..., None]
-        )  # (N, E, C)
-        combine = dispatch * gate[:, None, None]
+        )  # (G, S, E, C)
+        combine = dispatch * gate[..., None, None]
 
         w_up = self.param(
             "expert_up", nn.initializers.lecun_normal(), (e, d, self.ff_dim)
@@ -84,24 +104,30 @@ class SwitchFFN(nn.Module):
         )
         b_down = self.param("expert_down_bias", nn.initializers.zeros, (e, d))
 
-        xin = jnp.einsum("nec,nd->ecd", dispatch, xf.astype(jnp.float32))
+        xin = jnp.einsum("gsec,gsd->gecd", dispatch, xg.astype(jnp.float32))
         h = jnp.einsum(
-            "ecd,edf->ecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
-        ).astype(jnp.float32) + b_up[:, None, :]
+            "gecd,edf->gecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
+        ).astype(jnp.float32) + b_up[None, :, None, :]
         h = jax.nn.gelu(h)
         out = jnp.einsum(
-            "ecf,efd->ecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
-        ).astype(jnp.float32) + b_down[:, None, :]
-        y = jnp.einsum("nec,ecd->nd", combine, out)
+            "gecf,efd->gecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
+        ).astype(jnp.float32) + b_down[None, :, None, :]
+        y = jnp.einsum("gsec,gecd->gsd", combine, out)
 
         # Switch load-balance loss: E * sum_e f_e * p_e, minimized (=1) at
-        # uniform routing; scaled in by the training loss, not here
-        frac_tokens = onehot.mean(axis=0)
-        frac_probs = probs.mean(axis=0)
+        # uniform routing; scaled in by the training loss, not here.
+        # Padding rows are excluded so a partial final group can't skew it.
+        if n_pad != n:
+            valid = (jnp.arange(n_pad) < n).astype(jnp.float32).reshape(g, s, 1)
+            frac_tokens = (onehot * valid).sum(axis=(0, 1)) / n
+            frac_probs = (probs * valid).sum(axis=(0, 1)) / n
+        else:
+            frac_tokens = onehot.mean(axis=(0, 1))
+            frac_probs = probs.mean(axis=(0, 1))
         aux = e * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "aux_loss", aux)
 
-        return y.reshape(b, t, d).astype(x.dtype)
+        return y.reshape(n_pad, d)[:n].reshape(b, t, d).astype(x.dtype)
 
 
 def _is_expert_path(path: tuple) -> bool:

@@ -78,6 +78,12 @@ class BeholderService:
         self._emby_host = config.get("instance.emby.host")
         self._progress_counters = {}  # status text -> bound counter child
 
+        #: optional distributed tracing (the reference's triton-core layer
+        #: carries jaeger-client — SURVEY.md §5; spans live at this layer)
+        from beholder_tpu.tracing import tracer_from_config
+
+        self.tracer = tracer_from_config(config, logger=self.logger)
+
         #: optional batch-analytics extension (not part of reference parity)
         self.analytics = None
         if config.get("instance.analytics.enabled"):
@@ -106,9 +112,33 @@ class BeholderService:
     def start(self) -> None:
         """Register both consumers (index.js:62,127) and log 'initialized'."""
         self.broker.connect()
-        self.broker.listen(STATUS_TOPIC, self.handle_status)
-        self.broker.listen(PROGRESS_TOPIC, self.handle_progress)
+        status, progress = self.handle_status, self.handle_progress
+        if self.tracer is not None:
+            # wrap at registration time so the disabled path (the default,
+            # and the reference's behavior) pays zero per-message cost
+            status = self._traced("telemetry.status", status)
+            progress = self._traced("telemetry.progress", progress)
+        self.broker.listen(STATUS_TOPIC, status)
+        self.broker.listen(PROGRESS_TOPIC, progress)
         self.logger.info("initialized")
+
+    def _traced(self, operation: str, handler):
+        """Run ``handler`` inside a consumer span; joins the producer's
+        trace when the delivery carries an uber-trace-id header."""
+        from beholder_tpu.tracing import extract
+
+        tracer = self.tracer
+
+        def traced_handler(delivery: Delivery) -> None:
+            parent = extract(delivery.headers)
+            with tracer.start_span(
+                operation,
+                child_of=parent,
+                tags={"topic": delivery.topic, "redelivered": delivery.redelivered},
+            ):
+                handler(delivery)
+
+        return traced_handler
 
     # -- helpers -----------------------------------------------------------
     def comment(self, card_id: str, text: str) -> None:

@@ -31,6 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="beholder-publish", description=__doc__.split("\n\n")[0]
     )
     parser.add_argument("--url", default=None, help="amqp:// broker URL")
+    parser.add_argument(
+        "--trace",
+        action="store_true",
+        help="start a trace: send an uber-trace-id header so the consumer's "
+        "span joins this publish's trace",
+    )
     sub = parser.add_subparsers(dest="kind", required=True)
 
     status = sub.add_parser("status", help="publish a status transition")
@@ -68,13 +74,27 @@ def main(argv: list[str] | None = None, broker: AmqpBroker | None = None) -> int
     args = build_parser().parse_args(argv)
     topic, body = encode_message(args)
 
+    headers = None
+    span = None
+    if getattr(args, "trace", False):
+        from beholder_tpu.log import get_logger
+        from beholder_tpu.tracing import LogReporter, Tracer, inject
+
+        tracer = Tracer("beholder-publish", reporter=LogReporter(get_logger("trace")))
+        span = tracer.start_span(
+            "publish", tags={"topic": topic, "mediaId": args.media_id}
+        )
+        headers = inject(span.context, {})
+
     own_broker = broker is None
     if own_broker:
         broker = AmqpBroker(args.url or dyn("rabbitmq"))
         broker.connect(timeout=10)
     try:
-        broker.publish(topic, body)
+        broker.publish(topic, body, headers=headers)
     finally:
+        if span is not None:
+            span.finish()
         if own_broker:
             broker.close()
     print(f"published {args.kind} for {args.media_id} to {topic}")

@@ -1,0 +1,318 @@
+"""Distributed tracing: spans, samplers, reporters, and AMQP propagation.
+
+The reference's shared library ships jaeger-client + opentracing
+(/root/reference/yarn.lock:2000,2004 via triton-core), but index.js never
+opens a span — tracing exists one layer down, inside the library
+(SURVEY.md §5 "Tracing / profiling"). This module is that layer's
+from-scratch equivalent: a jaeger-flavored tracer the service wires into
+its consumers behind ``instance.tracing.enabled``.
+
+Design (no opentracing/jaeger package exists in this image):
+
+- ``SpanContext`` is the (trace_id, span_id, parent_id, flags) tuple;
+  ``inject``/``extract`` speak the jaeger text-map format — one
+  ``uber-trace-id: {trace:032x}:{span:016x}:{parent:016x}:{flags:x}``
+  entry — carried in the AMQP basic-properties headers table
+  (``Delivery.headers``), so producer→consumer traces stitch across
+  processes exactly like jaeger's AMQP instrumentation.
+- ``Span`` records operation, service, start/duration (epoch µs, jaeger's
+  unit), tags, and logs; finished spans go to a pluggable reporter.
+- Reporters: ``InMemoryReporter`` (tests/introspection), ``LogReporter``
+  (one structured line per span through the pino-style logger),
+  ``JsonlReporter`` (one jaeger-shaped JSON object per line, for offline
+  ingestion).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from secrets import randbits
+from typing import Any, Callable
+
+TRACE_HEADER = "uber-trace-id"
+FLAG_SAMPLED = 0x01
+
+
+class SpanContext:
+    """Immutable identity of one span in one trace."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "flags")
+
+    def __init__(
+        self, trace_id: int, span_id: int, parent_id: int = 0, flags: int = FLAG_SAMPLED
+    ):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.flags = flags
+
+    @property
+    def sampled(self) -> bool:
+        return bool(self.flags & FLAG_SAMPLED)
+
+    def encode(self) -> str:
+        return (
+            f"{self.trace_id:032x}:{self.span_id:016x}"
+            f":{self.parent_id:016x}:{self.flags:x}"
+        )
+
+    @classmethod
+    def decode(cls, value: str) -> "SpanContext":
+        trace_id, span_id, parent_id, flags = value.split(":")
+        return cls(int(trace_id, 16), int(span_id, 16), int(parent_id, 16), int(flags, 16))
+
+    def __repr__(self) -> str:
+        return f"SpanContext({self.encode()})"
+
+
+def inject(ctx: SpanContext, carrier: dict) -> dict:
+    """Write ``ctx`` into a headers carrier (AMQP headers table / dict)."""
+    carrier[TRACE_HEADER] = ctx.encode()
+    return carrier
+
+
+def extract(carrier: dict | None) -> SpanContext | None:
+    """Read a :class:`SpanContext` out of a headers carrier; None if absent
+    or malformed (a broken upstream header must never kill a consumer)."""
+    if not carrier:
+        return None
+    value = carrier.get(TRACE_HEADER)
+    if not value:
+        return None
+    try:
+        return SpanContext.decode(str(value))
+    except (ValueError, AttributeError):
+        return None
+
+
+class Span:
+    """One timed operation. Finish exactly once; use as a context manager
+    to get error tagging + finish on the way out."""
+
+    __slots__ = (
+        "context",
+        "operation",
+        "service",
+        "start_us",
+        "duration_us",
+        "tags",
+        "logs",
+        "_tracer",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        operation: str,
+        context: SpanContext,
+        tags: dict[str, Any] | None = None,
+    ):
+        self._tracer = tracer
+        self.operation = operation
+        self.service = tracer.service
+        self.context = context
+        self.start_us = int(time.time() * 1e6)
+        self.duration_us: int | None = None
+        self.tags: dict[str, Any] = dict(tags or {})
+        self.logs: list[dict[str, Any]] = []
+
+    def set_tag(self, key: str, value: Any) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def log(self, event: str, **fields: Any) -> "Span":
+        self.logs.append(
+            {"timestamp_us": int(time.time() * 1e6), "event": event, **fields}
+        )
+        return self
+
+    def finish(self) -> None:
+        if self.duration_us is not None:
+            return  # finish is idempotent, like opentracing's
+        self.duration_us = int(time.time() * 1e6) - self.start_us
+        self._tracer._report(self)
+
+    @property
+    def finished(self) -> bool:
+        return self.duration_us is not None
+
+    # -- context manager ----------------------------------------------------
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        if exc is not None:
+            self.set_tag("error", True)
+            self.log("error", message=repr(exc))
+        self.finish()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "traceID": f"{self.context.trace_id:032x}",
+            "spanID": f"{self.context.span_id:016x}",
+            "parentSpanID": f"{self.context.parent_id:016x}",
+            "operationName": self.operation,
+            "serviceName": self.service,
+            "startTime": self.start_us,
+            "duration": self.duration_us,
+            "tags": self.tags,
+            "logs": self.logs,
+        }
+
+
+class _NoopSpan:
+    """Returned for unsampled traces: absorbs the Span API at near-zero
+    cost and never reaches a reporter."""
+
+    __slots__ = ("context",)
+
+    def __init__(self, context: SpanContext):
+        self.context = context
+
+    def set_tag(self, key: str, value: Any) -> "_NoopSpan":
+        return self
+
+    def log(self, event: str, **fields: Any) -> "_NoopSpan":
+        return self
+
+    def finish(self) -> None:
+        pass
+
+    finished = True
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        pass
+
+
+# -- reporters ---------------------------------------------------------------
+
+
+class InMemoryReporter:
+    """Collects finished spans; the test/introspection sink."""
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def report(self, span: Span) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+    def by_operation(self, operation: str) -> list[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.operation == operation]
+
+
+class LogReporter:
+    """One structured log line per finished span."""
+
+    def __init__(self, logger):
+        self._logger = logger
+
+    def report(self, span: Span) -> None:
+        self._logger.info(
+            "span %s %s trace=%032x span=%016x duration_us=%d tags=%s",
+            span.service,
+            span.operation,
+            span.context.trace_id,
+            span.context.span_id,
+            span.duration_us,
+            span.tags,
+        )
+
+
+class JsonlReporter:
+    """One jaeger-shaped JSON object per line, append-only."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def report(self, span: Span) -> None:
+        line = json.dumps(span.to_dict(), default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+class Tracer:
+    """Makes spans, samples, reports. One per service process.
+
+    ``sample_rate`` is probabilistic head sampling (jaeger's
+    ``probabilistic`` sampler): the root span decides, children inherit the
+    decision through the flags bit so a trace is never half-reported.
+    """
+
+    def __init__(
+        self,
+        service: str,
+        reporter=None,
+        sample_rate: float = 1.0,
+        _rand: Callable[[], float] | None = None,
+    ):
+        self.service = service
+        self.reporter = reporter if reporter is not None else InMemoryReporter()
+        self.sample_rate = sample_rate
+        self._rand = _rand or __import__("random").random
+
+    def start_span(
+        self,
+        operation: str,
+        child_of: SpanContext | Span | None = None,
+        tags: dict[str, Any] | None = None,
+    ) -> Span | _NoopSpan:
+        parent = child_of.context if isinstance(child_of, Span) else child_of
+        if parent is not None:
+            ctx = SpanContext(
+                trace_id=parent.trace_id,
+                span_id=randbits(64) or 1,
+                parent_id=parent.span_id,
+                flags=parent.flags,  # inherit the head-sampling decision
+            )
+        else:
+            sampled = self.sample_rate >= 1.0 or self._rand() < self.sample_rate
+            ctx = SpanContext(
+                trace_id=randbits(128) or 1,
+                span_id=randbits(64) or 1,
+                parent_id=0,
+                flags=FLAG_SAMPLED if sampled else 0,
+            )
+        if not ctx.sampled:
+            return _NoopSpan(ctx)
+        return Span(self, operation, ctx, tags)
+
+    def _report(self, span: Span) -> None:
+        try:
+            self.reporter.report(span)
+        except Exception:  # noqa: BLE001 - a broken sink must not kill work
+            pass
+
+
+def tracer_from_config(config, logger=None) -> Tracer | None:
+    """Build the service tracer from ``instance.tracing.*`` config, or None
+    when disabled (the default — the reference never opens spans either).
+
+    Keys: ``enabled`` (bool), ``sample_rate`` (float, default 1.0),
+    ``jsonl_path`` (str; also via $TRACE_JSONL — when set, spans append
+    there instead of the log).
+    """
+    if not config.get("instance.tracing.enabled"):
+        return None
+    path = os.environ.get("TRACE_JSONL") or config.get("instance.tracing.jsonl_path")
+    if path:
+        reporter = JsonlReporter(str(path))
+    elif logger is not None:
+        reporter = LogReporter(logger)
+    else:
+        reporter = InMemoryReporter()
+    rate = float(config.get("instance.tracing.sample_rate", 1.0))
+    return Tracer("beholder", reporter=reporter, sample_rate=rate)

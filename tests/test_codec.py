@@ -87,3 +87,68 @@ def test_body_frames_split_by_frame_max():
 def test_truncated_payload_raises():
     with pytest.raises(codec.ProtocolError):
         codec.Reader(b"\x01").short()
+
+
+def test_table_int64_and_float_roundtrip():
+    """Header ints outside int32 take the 'l' (int64) encoding; floats take
+    'd' — a microsecond epoch timestamp must survive the table."""
+    from beholder_tpu.mq.codec import Reader, Writer
+
+    t = {"ts_us": 1_785_335_299_755_364, "neg": -(1 << 40), "pi": 3.5, "n": 7}
+    payload = Writer().table(t).getvalue()
+    assert Reader(payload).table() == t
+
+
+def test_table_oversized_int_raises_protocol_error():
+    from beholder_tpu.mq.codec import ProtocolError, Writer
+
+    with pytest.raises(ProtocolError):
+        Writer().table({"too_big": 1 << 70})
+
+
+def test_reader_decodes_rabbitmq_field_types():
+    """The consume path must read the full RabbitMQ field-type set — a
+    dead-lettered message's x-death header carries arrays and timestamps."""
+    import struct
+
+    from beholder_tpu.mq.codec import Reader, Writer
+
+    # hand-build a table the way RabbitMQ would encode x-death-ish data
+    body = Writer()
+    body.shortstr("x-death")
+    # array of one table: [{count: int64, time: timestamp}]
+    inner = Writer()
+    inner.shortstr("count")
+    inner._parts.append(b"l" + struct.pack(">q", 3))
+    inner.shortstr("time")
+    inner._parts.append(b"T" + struct.pack(">Q", 1_700_000_000))
+    inner_table = inner.getvalue()
+    item = b"F" + struct.pack(">I", len(inner_table)) + inner_table
+    body._parts.append(b"A" + struct.pack(">I", len(item)) + item)
+    body.shortstr("ratio")
+    body._parts.append(b"d" + struct.pack(">d", 0.25))
+    payload = Writer().longstr(body.getvalue()).getvalue()
+
+    table = Reader(payload).table()
+    assert table["x-death"] == [{"count": 3, "time": 1_700_000_000}]
+    assert table["ratio"] == 0.25
+
+
+def test_unknown_header_field_type_does_not_kill_delivery():
+    """parse_basic_header degrades to empty headers on an unparseable
+    table instead of raising into the connection's frame loop."""
+    import struct
+
+    from beholder_tpu.mq.codec import (
+        CLASS_BASIC,
+        header_frame,
+        parse_basic_header,
+    )
+
+    frame = header_frame(1, CLASS_BASIC, 42, headers={"k": "v"})
+    # corrupt the field type byte ('S') to an unknown kind
+    payload = bytearray(frame.payload)
+    payload[payload.index(b"S"[0], 14)] = ord("?")
+    size, headers = parse_basic_header(bytes(payload))
+    assert size == 42
+    assert headers == {}

@@ -1,0 +1,199 @@
+"""Tracing: span lifecycle, propagation, sampling, service integration."""
+
+import json
+
+import pytest
+
+from beholder_tpu import proto
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.mq import InMemoryBroker
+from beholder_tpu.service import PROGRESS_TOPIC, STATUS_TOPIC, BeholderService
+from beholder_tpu.storage import MemoryStorage
+from beholder_tpu.tracing import (
+    FLAG_SAMPLED,
+    InMemoryReporter,
+    JsonlReporter,
+    SpanContext,
+    Tracer,
+    extract,
+    inject,
+    tracer_from_config,
+)
+
+
+@pytest.fixture
+def tracer():
+    return Tracer("test", reporter=InMemoryReporter())
+
+
+def test_span_lifecycle_and_report(tracer):
+    span = tracer.start_span("op", tags={"k": "v"})
+    span.set_tag("n", 2).log("checkpoint", detail="x")
+    assert not span.finished
+    span.finish()
+    assert span.finished
+    span.finish()  # idempotent
+    (reported,) = tracer.reporter.spans
+    assert reported.operation == "op"
+    assert reported.tags == {"k": "v", "n": 2}
+    assert reported.logs[0]["event"] == "checkpoint"
+    assert reported.duration_us >= 0
+
+
+def test_child_span_inherits_trace_and_links_parent(tracer):
+    root = tracer.start_span("root")
+    child = tracer.start_span("child", child_of=root)
+    assert child.context.trace_id == root.context.trace_id
+    assert child.context.parent_id == root.context.span_id
+    assert child.context.span_id != root.context.span_id
+
+
+def test_inject_extract_roundtrip():
+    ctx = SpanContext(trace_id=0xABC, span_id=0x123, parent_id=0x7, flags=1)
+    carrier = inject(ctx, {})
+    assert carrier == {"uber-trace-id": ctx.encode()}
+    out = extract(carrier)
+    assert (out.trace_id, out.span_id, out.parent_id, out.flags) == (
+        0xABC,
+        0x123,
+        0x7,
+        1,
+    )
+
+
+@pytest.mark.parametrize(
+    "carrier", [None, {}, {"uber-trace-id": "garbage"}, {"uber-trace-id": 42}]
+)
+def test_extract_tolerates_junk(carrier):
+    assert extract(carrier) is None
+
+
+def test_error_exit_tags_and_finishes(tracer):
+    with pytest.raises(RuntimeError):
+        with tracer.start_span("boom") as span:
+            raise RuntimeError("nope")
+    assert span.finished
+    assert span.tags["error"] is True
+    assert any(log["event"] == "error" for log in span.logs)
+
+
+def test_probabilistic_sampling_head_decision():
+    # rand() above the rate -> root unsampled -> noop span, nothing reported
+    tracer = Tracer(
+        "t", reporter=InMemoryReporter(), sample_rate=0.5, _rand=lambda: 0.9
+    )
+    root = tracer.start_span("root")
+    root.set_tag("x", 1).log("e")
+    root.finish()
+    assert tracer.reporter.spans == []
+    # children inherit the unsampled decision through the flags bit
+    child = tracer.start_span("child", child_of=root.context)
+    child.finish()
+    assert tracer.reporter.spans == []
+    assert not root.context.flags & FLAG_SAMPLED
+
+
+def test_jsonl_reporter_writes_jaeger_shape(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tracer = Tracer("svc", reporter=JsonlReporter(str(path)))
+    with tracer.start_span("op", tags={"topic": "t"}):
+        pass
+    (line,) = path.read_text().strip().split("\n")
+    span = json.loads(line)
+    assert span["operationName"] == "op"
+    assert span["serviceName"] == "svc"
+    assert len(span["traceID"]) == 32 and len(span["spanID"]) == 16
+    assert span["tags"] == {"topic": "t"}
+
+
+def test_tracer_from_config_disabled_by_default():
+    assert tracer_from_config(ConfigNode({})) is None
+
+
+# -- service integration -----------------------------------------------------
+
+
+def make_service(extra_instance=None):
+    instance = {
+        "flow_ids": {"queued": "l0"},
+        "tracing": {"enabled": True},
+        **(extra_instance or {}),
+    }
+    config = ConfigNode(
+        {"keys": {"trello": {"key": "K", "token": "T"}}, "instance": instance}
+    )
+    db = MemoryStorage()
+    db.add_media(
+        proto.Media(
+            id="m1",
+            name="M",
+            creator=proto.CreatorType.TRELLO,
+            creatorId="c1",
+            metadataId="1",
+        )
+    )
+
+    class _Transport:
+        def request(self, *a, **k):
+            from beholder_tpu.clients.http import HttpResponse
+
+            return HttpResponse(status=200, body={})
+
+    broker = InMemoryBroker()
+    service = BeholderService(config, broker, db, transport=_Transport())
+    # swap in the introspectable reporter
+    service.tracer.reporter = InMemoryReporter()
+    service.start()
+    return service, broker
+
+
+def test_consumer_spans_reported_with_tags():
+    service, broker = make_service()
+    broker.publish(
+        STATUS_TOPIC,
+        proto.encode(proto.TelemetryStatus(mediaId="m1", status=0)),
+    )
+    broker.publish(
+        PROGRESS_TOPIC,
+        proto.encode(
+            proto.TelemetryProgress(mediaId="m1", status=0, progress=5, host="h")
+        ),
+    )
+    spans = service.tracer.reporter.spans
+    assert [s.operation for s in spans] == ["telemetry.status", "telemetry.progress"]
+    assert spans[0].tags["topic"] == STATUS_TOPIC
+    assert spans[0].context.parent_id == 0  # no producer context -> new trace
+
+
+def test_consumer_span_joins_producer_trace():
+    service, broker = make_service()
+    producer = Tracer("producer", reporter=InMemoryReporter())
+    pspan = producer.start_span("publish")
+    broker.publish(
+        STATUS_TOPIC,
+        proto.encode(proto.TelemetryStatus(mediaId="m1", status=0)),
+        headers=inject(pspan.context, {}),
+    )
+    pspan.finish()
+    (span,) = service.tracer.reporter.spans
+    assert span.context.trace_id == pspan.context.trace_id
+    assert span.context.parent_id == pspan.context.span_id
+
+
+def test_failed_status_handler_reports_error_span():
+    service, broker = make_service()
+    broker.publish(
+        STATUS_TOPIC,
+        proto.encode(proto.TelemetryStatus(mediaId="missing", status=0)),
+    )
+    (span,) = service.tracer.reporter.spans
+    assert span.tags.get("error") is True
+    assert broker.in_flight == 1  # parity: failing status deliveries unacked
+
+
+def test_tracing_disabled_leaves_handlers_bare():
+    config = ConfigNode(
+        {"keys": {"trello": {"key": "K", "token": "T"}}, "instance": {}}
+    )
+    service = BeholderService(config, InMemoryBroker(), MemoryStorage())
+    assert service.tracer is None
