@@ -6,11 +6,19 @@ are next-step deltas (same self-supervision as the MLP flagship, but over
 arbitrarily long streams). The attention backend is pluggable:
 
 - ``attention="full"``  — O(T^2) on one device (short streams)
+- ``attention="flash"`` — single-device flash attention: Pallas forward
+  kernel + blocked XLA backward, O(T * block) memory
+  (:mod:`beholder_tpu.ops.flash_attention`)
 - ``attention="ring"``  — context-parallel ring attention over an ``sp``
   mesh axis (:func:`beholder_tpu.ops.attention.ring_attention`): each
   device holds T/P of the stream, k/v blocks rotate over ICI, memory per
   device stays O(T/P * d). This is how week-long telemetry streams score
   without a single-chip memory wall.
+- ``attention="ulysses"`` — Ulysses sequence parallelism over ``sp``:
+  one all-to-all trades sequence shards for head shards, flash attention
+  runs on whole-sequence heads, one all-to-all trades back
+  (:func:`beholder_tpu.ops.attention.ulysses_attention`). Needs
+  heads % sp == 0; cheaper collectives than ring for moderate T.
 
 TPU-first notes: static shapes throughout; bfloat16 matmuls with float32
 accumulation; heads/features sized for MXU tiles.
@@ -25,7 +33,12 @@ from flax import linen as nn
 from jax.sharding import Mesh
 
 from beholder_tpu.ops import NUM_STATUSES
-from beholder_tpu.ops.attention import full_attention, ring_attention
+from beholder_tpu.ops.attention import (
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from beholder_tpu.ops.flash_attention import flash_attention
 from beholder_tpu.ops.moe import SwitchFFN
 
 from .train import TrainState, apply_gradients
@@ -36,7 +49,7 @@ FEATURES = 1 + NUM_STATUSES
 class Block(nn.Module):
     dim: int
     heads: int
-    attention: str = "full"  # "full" | "ring"
+    attention: str = "full"  # "full" | "flash" | "ring" | "ulysses"
     mesh: Mesh | None = None
     ffn: str = "dense"  # "dense" | "moe"
     num_experts: int = 4
@@ -52,10 +65,14 @@ class Block(nn.Module):
         q, k, v = (
             a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3) for a in (q, k, v)
         )
+        if self.attention in ("ring", "ulysses") and self.mesh is None:
+            raise ValueError(f"{self.attention} attention needs a mesh")
         if self.attention == "ring":
-            if self.mesh is None:
-                raise ValueError("ring attention needs a mesh")
             att = ring_attention(q, k, v, self.mesh, causal=True)
+        elif self.attention == "ulysses":
+            att = ulysses_attention(q, k, v, self.mesh, causal=True)
+        elif self.attention == "flash":
+            att = flash_attention(q, k, v, causal=True)
         else:
             att = full_attention(q, k, v, causal=True)
         att = att.transpose(0, 2, 1, 3).reshape(b, t, d)

@@ -314,10 +314,11 @@ def parse_basic_header(payload: bytes) -> tuple[int, dict[str, Any]]:
     if flags & _FLAG_HEADERS:
         try:
             headers = reader.table()
-        except ProtocolError:
+        except (ProtocolError, UnicodeDecodeError):
             # headers are optional metadata; a table with a field type from
-            # a future spec revision must not kill the connection (the body
-            # size above is already parsed, so delivery proceeds)
+            # a future spec revision — or a non-UTF-8 key from a foreign
+            # client — must not kill the connection (the body size above is
+            # already parsed, so delivery proceeds)
             headers = {}
     return body_size, headers
 

@@ -1,4 +1,5 @@
-"""Attention ops: full reference + ring attention (context parallelism).
+"""Attention ops: full reference, ring attention (context parallelism),
+and Ulysses all-to-all sequence parallelism.
 
 EXTENSION BEYOND THE REFERENCE (which has no attention, no sequences, no
 tensors — SURVEY.md §5 "Long-context / sequence parallelism: Absent").
@@ -123,6 +124,59 @@ def ring_attention(
         return (o / l[..., None]).astype(q.dtype)
 
     spec = P(*([None] * (q.ndim - 2)), axis, None)
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    backend: str = "flash",
+) -> jax.Array:
+    """DeepSpeed-Ulysses sequence parallelism: all-to-all head scatter.
+
+    Inputs are (B, H, T, d) global arrays sharded along T on ``axis``.
+    Each device trades its T/P sequence slice of all H heads for the FULL
+    sequence of H/P heads (one ``all_to_all``, riding ICI on hardware),
+    runs ordinary attention on those whole-sequence heads — flash by
+    default, so the (T, T) matrix never exists — then reverses the
+    exchange. Two all-to-alls per call vs ring attention's P-1 ppermutes;
+    the tradeoff is H % P == 0 and O(T) k/v memory per device (vs ring's
+    O(T/P)), which buys much better compute locality for moderate T.
+    """
+    p_size = mesh.shape[axis]
+    b, h, t, d = q.shape
+    if h % p_size:
+        raise ValueError(f"heads {h} not divisible by {axis}={p_size}")
+    if t % p_size:
+        raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
+
+    if backend == "flash":
+        from beholder_tpu.ops.flash_attention import flash_attention as attend
+    else:
+        attend = full_attention
+
+    def local(qb, kb, vb):
+        # (B, H, T/P, d) -> (B, H/P, T, d): split heads, gather sequence
+        qh, kh, vh = (
+            jax.lax.all_to_all(a, axis, split_axis=1, concat_axis=2, tiled=True)
+            for a in (qb, kb, vb)
+        )
+        att = attend(qh, kh, vh, causal=causal)
+        # (B, H/P, T, d) -> (B, H, T/P, d)
+        return jax.lax.all_to_all(att, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    spec = P(None, None, axis, None)
     sharded = jax.shard_map(
         local,
         mesh=mesh,

@@ -1,0 +1,223 @@
+"""Flash attention as a Pallas TPU kernel (forward) + blocked XLA backward.
+
+EXTENSION BEYOND THE REFERENCE (which has no attention or tensors of any
+kind — SURVEY.md §0/§5). This is the single-device fast path behind the
+sequence models' ``attention="flash"`` backend; ring attention
+(:mod:`beholder_tpu.ops.attention`) distributes the same online-softmax
+recurrence across chips.
+
+Design (see /opt/skills/guides/pallas_guide.md):
+
+- Forward kernel: grid over (batch*heads, q blocks). Each step holds one
+  (block_q, d) q tile plus the full (T, d) k/v for its batch-head in VMEM
+  and runs the online-softmax recurrence over k/v blocks with a
+  ``fori_loop`` — running max m, normalizer l, and unnormalized
+  accumulator — so the (T, T) score matrix never exists. For causal
+  masking the loop stops after the q block's diagonal.
+- The kernel also emits the row logsumexp, which makes the backward
+  recomputation exact.
+- Backward: a custom-VJP rule in blocked XLA (scan over k/v blocks,
+  recomputing probabilities from the saved logsumexp — the standard flash
+  backward). Memory stays O(T * block) instead of O(T^2); XLA keeps the
+  einsums on the MXU.
+- Head dim is zero-padded to the 128-lane width and T to a block
+  multiple; padded k/v columns are masked with -inf so they contribute
+  nothing, and padded d columns contribute zeros to every dot product.
+- On non-TPU backends the kernel runs in interpreter mode, so the same
+  code path is exercised by the CPU-mesh tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+_LANES = 128
+_BLOCK = 128  # q/kv block rows; also the T padding granule
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, t_real, causal, scale):
+    """One (block_q, d) q tile against all k/v blocks of its batch-head."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+    bq, d = q.shape
+    t_pad = k_ref.shape[1]
+    n_kv = t_pad // _BLOCK
+    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, _BLOCK), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :]
+        vb = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :]
+        s = jax.lax.dot_general(
+            q,
+            kb.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, BLOCK)
+        cols = j * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, (bq, _BLOCK), 1)
+        valid = cols < t_real
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        scale_old = jnp.exp(m - m_new)
+        l_new = l * scale_old + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * scale_old + jax.lax.dot_general(
+            p,
+            vb.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # blocks past the diagonal are fully masked; skip them. bq ==
+        # _BLOCK always (T is padded to a block multiple), so q tile qi's
+        # diagonal k/v block is exactly block qi.
+        hi = jnp.minimum(n_kv, qi + 1)
+    else:
+        hi = n_kv
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    # fully-masked rows (q padding) have l=0; emit 0 output, -inf lse
+    safe_l = jnp.maximum(l, 1e-37)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), _NEG_INF)
+    # lse is broadcast over 8 sublanes purely to satisfy the (8, 128) f32
+    # tile rule for output blocks; the wrapper reads sublane 0
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret", "t_real", "scale"))
+def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
+    """(BH, T_pad, d_pad) inputs -> (o, lse) with the same padding."""
+    bh, t_pad, d_pad = q.shape
+    grid = (bh, t_pad // _BLOCK)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, t_real=t_real, causal=causal, scale=scale
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, _BLOCK), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, t_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[:, 0, :]
+
+
+def _pad_to(x, t_pad, d_pad):
+    t, d = x.shape[-2], x.shape[-1]
+    return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, d_pad - d)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    return _flash_fwd_res(q, k, v, causal)[0]
+
+
+def _flash_fwd_res(q, k, v, causal):
+    bh, t, d = q.shape
+    t_pad = -(-t // _BLOCK) * _BLOCK
+    d_pad = -(-d // _LANES) * _LANES
+    scale = float(1.0 / (d**0.5))
+    interpret = jax.devices()[0].platform != "tpu"
+    qp, kp, vp = (_pad_to(a, t_pad, d_pad) for a in (q, k, v))
+    o, lse = _flash_fwd_padded(
+        qp, kp, vp, causal=causal, interpret=interpret, t_real=t, scale=scale
+    )
+    return o[:, :t, :d], lse[:, :t]
+
+
+def _flash_fwd(q, k, v, causal):
+    o, lse = _flash_fwd_res(q, k, v, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, res, do):
+    """Blocked flash backward in XLA: scan over k/v blocks, recomputing
+    probabilities from the saved logsumexp. O(T * block) memory."""
+    q, k, v, o, lse = res
+    bh, t, d = q.shape
+    scale = 1.0 / (d**0.5)
+
+    # pad T to a block multiple (same discipline as the forward) so the
+    # scan below never degenerates to one full (T, T) block. Padded q rows
+    # get lse=+BIG so their probabilities underflow to exactly 0 (an -inf
+    # pad would make exp(0 - lse) blow up); padded k/v columns are masked
+    # in the scores; padded do/o rows are zero so every gradient term from
+    # padding vanishes.
+    block = min(_BLOCK, t)
+    t_pad = -(-t // block) * block
+    pad = ((0, 0), (0, t_pad - t), (0, 0))
+    qf = jnp.pad(q.astype(jnp.float32), pad)
+    do_f = jnp.pad(do.astype(jnp.float32), pad)
+    of = jnp.pad(o.astype(jnp.float32), pad)
+    kf = jnp.pad(k.astype(jnp.float32), pad)
+    vf = jnp.pad(v.astype(jnp.float32), pad)
+    lse_p = jnp.pad(lse, ((0, 0), (0, t_pad - t)), constant_values=1e30)
+
+    delta = jnp.sum(do_f * of, axis=-1)  # (BH, T_pad)
+    rows = jnp.arange(t_pad)
+
+    n_blocks = t_pad // block
+    kb = kf.reshape(bh, n_blocks, block, d).transpose(1, 0, 2, 3)
+    vb = vf.reshape(bh, n_blocks, block, d).transpose(1, 0, 2, 3)
+
+    def body(dq, blk):
+        j, kj, vj = blk
+        cols = j * block + jnp.arange(block)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kj) * scale
+        valid = (cols < t)[None, :]
+        if causal:
+            valid = valid & (rows[:, None] >= cols[None, :])
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse_p[..., None])  # masked/-inf entries -> exactly 0
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, do_f)
+        dp = jnp.einsum("bqd,bkd->bqk", do_f, vj)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kj)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_b, dv_b) = jax.lax.scan(body, dq0, (jnp.arange(n_blocks), kb, vb))
+    dk = dk_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
+    dv = dv_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
+    return dq[:, :t].astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Memory-efficient attention. (..., T, d) -> (..., T, d).
+
+    Matches :func:`beholder_tpu.ops.attention.full_attention` to float
+    tolerance; never materializes the (T, T) score matrix in either pass.
+    """
+    shape = q.shape
+    t, d = shape[-2], shape[-1]
+    q3, k3, v3 = (a.reshape(-1, t, d) for a in (q, k, v))
+    return _flash(q3, k3, v3, causal).reshape(shape)

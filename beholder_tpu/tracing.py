@@ -101,6 +101,7 @@ class Span:
         "tags",
         "logs",
         "_tracer",
+        "_t0_ns",
     )
 
     def __init__(
@@ -114,7 +115,8 @@ class Span:
         self.operation = operation
         self.service = tracer.service
         self.context = context
-        self.start_us = int(time.time() * 1e6)
+        self.start_us = int(time.time() * 1e6)  # epoch, for jaeger startTime
+        self._t0_ns = time.perf_counter_ns()  # monotonic, for duration
         self.duration_us: int | None = None
         self.tags: dict[str, Any] = dict(tags or {})
         self.logs: list[dict[str, Any]] = []
@@ -132,7 +134,9 @@ class Span:
     def finish(self) -> None:
         if self.duration_us is not None:
             return  # finish is idempotent, like opentracing's
-        self.duration_us = int(time.time() * 1e6) - self.start_us
+        # monotonic delta: an NTP step between start and finish must not
+        # corrupt (or negate) the one number tracing exists to measure
+        self.duration_us = (time.perf_counter_ns() - self._t0_ns) // 1000
         self._tracer._report(self)
 
     @property

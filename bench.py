@@ -130,13 +130,20 @@ def bench_aggregation() -> dict:
     statuses = jax.device_put(rng.integers(0, 6, size=batch))
     progress = jax.device_put(rng.integers(0, 101, size=batch))
 
+    def materialize(out):
+        # host readback, not block_until_ready: under the axon TPU tunnel
+        # block_until_ready returns before execution finishes, which
+        # inflated earlier measurements; pulling a scalar to the host is
+        # the only reliable completion barrier
+        return float(np.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+
     out = aggregate_telemetry(statuses, progress)  # compile + warm
-    jax.block_until_ready(out)
+    materialize(out)
     reps = 20
     start = time.perf_counter()
     for _ in range(reps):
         out = aggregate_telemetry(statuses, progress)
-    jax.block_until_ready(out)
+    materialize(out)
     elapsed = time.perf_counter() - start
     events_per_sec = batch * reps / elapsed
     return {
@@ -146,9 +153,48 @@ def bench_aggregation() -> dict:
     }
 
 
+def bench_flash_attention() -> dict:
+    """Secondary: the Pallas flash-attention kernel vs XLA full attention
+    on the accelerator (causal, bf16, B=4 H=8 T=4096 d=128)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from beholder_tpu.ops.attention import full_attention
+    from beholder_tpu.ops.flash_attention import flash_attention
+
+    b, h, t, d = 4, 8, 4096, 128
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d), jnp.bfloat16)
+        for i in range(3)
+    )
+    flops = 4 * b * h * t * t * d / 2  # causal
+
+    def measure(fn):
+        f = jax.jit(lambda q, k, v: fn(q, k, v, causal=True))
+        out = f(q, k, v)
+        float(np.asarray(out[0, 0, 0, 0]))  # host readback barrier
+        reps = 20
+        start = time.perf_counter()
+        for _ in range(reps):
+            out = f(q, k, v)
+        float(np.asarray(out[0, 0, 0, 0]))
+        return flops * reps / (time.perf_counter() - start)
+
+    full_tf = measure(full_attention)
+    flash_tf = measure(flash_attention)
+    return {
+        "metric": "flash_attention_tflops",
+        "value": round(flash_tf / 1e12, 2),
+        "xla_full_attention_tflops": round(full_tf / 1e12, 2),
+        "speedup_vs_xla": round(flash_tf / full_tf, 2),
+    }
+
+
 def main() -> None:
     msgs_per_sec = bench_service()
     secondary = bench_aggregation()
+    secondary["flash"] = bench_flash_attention()
     print(
         json.dumps(
             {

@@ -152,3 +152,20 @@ def test_unknown_header_field_type_does_not_kill_delivery():
     size, headers = parse_basic_header(bytes(payload))
     assert size == 42
     assert headers == {}
+
+
+def test_non_utf8_header_key_does_not_kill_delivery():
+    """A foreign client's non-UTF-8 header key degrades to empty headers
+    instead of raising out of the frame loop."""
+    import struct
+
+    from beholder_tpu.mq.codec import CLASS_BASIC, parse_basic_header
+
+    # flags with only the headers bit; table with one invalid-UTF-8 key
+    bad_key = b"\xff\xfe"
+    entry = bytes([len(bad_key)]) + bad_key + b"S" + struct.pack(">I", 1) + b"x"
+    table = struct.pack(">I", len(entry)) + entry
+    payload = struct.pack(">HHQH", CLASS_BASIC, 0, 7, 1 << 13) + table
+    size, headers = parse_basic_header(payload)
+    assert size == 7
+    assert headers == {}

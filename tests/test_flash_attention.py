@@ -1,0 +1,174 @@
+"""Flash attention (Pallas fwd + blocked XLA bwd) and Ulysses sequence
+parallelism, against the full-attention reference on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from beholder_tpu.ops.attention import (
+    full_attention,
+    sequence_sharding,
+    ulysses_attention,
+)
+from beholder_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(seed, b=2, h=2, t=64, d=16):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, t, d), jnp.float32) for k in keys)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_full(causal):
+    q, k, v = _qkv(0)
+    want = full_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_unaligned_t_and_small_d():
+    """T not a block multiple and d far below the 128-lane width: the
+    padding path must mask padded kv columns to nothing."""
+    q, k, v = _qkv(1, b=1, h=3, t=77, d=9)
+    want = full_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_gradients_match_full():
+    q, k, v = _qkv(2, t=96)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+
+    want = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
+def test_flash_never_materializes_scores():
+    """The jaxpr must contain no (T, T) intermediate."""
+    q, k, v = _qkv(3, b=1, h=1, t=256, d=16)
+    jaxpr = jax.make_jaxpr(lambda q, k, v: flash_attention(q, k, v, True))(q, k, v)
+    t = 256
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in eqn.outvars:
+            assert var.aval.shape[-2:] != (t, t), f"(T,T) tensor from {eqn.primitive}"
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(sp_mesh, causal):
+    q, k, v = _qkv(4, b=2, h=8, t=128, d=16)
+    want = full_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, sp_mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_with_sharded_inputs_stays_sharded(sp_mesh):
+    q, k, v = _qkv(5, b=1, h=8, t=128, d=16)
+    shard = sequence_sharding(sp_mesh, q.ndim)
+    qs, ks, vs = (jax.device_put(a, shard) for a in (q, k, v))
+    out = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, causal=True))(
+        qs, ks, vs
+    )
+    assert out.sharding.spec == shard.spec
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(sp_mesh):
+    q, k, v = _qkv(6, b=1, h=6, t=128, d=16)
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, sp_mesh)
+
+
+def test_ulysses_gradients_flow(sp_mesh):
+    """A ulysses training step differentiates through both all-to-alls."""
+    q, k, v = _qkv(7, b=1, h=8, t=64, d=8)
+
+    def loss(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, sp_mesh, causal=True) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
+def test_sequence_model_with_flash_and_ulysses(sp_mesh):
+    """Both new backends slot into TelemetrySequenceModel and train."""
+    from beholder_tpu.models.sequence import (
+        TelemetrySequenceModel,
+        init_seq_state,
+        seq_train_step,
+        stream_features,
+    )
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    rng = np.random.default_rng(0)
+    t = 64
+    prog = jnp.asarray(np.cumsum(1.0 + rng.normal(0, 0.05, (2, t + 1)), axis=-1))
+    stats = jnp.full((2, t + 1), TelemetryStatusEntry.CONVERTING)
+    feats, targets = stream_features(prog, stats)
+
+    for backend, kwargs in [
+        ("flash", {}),
+        ("ulysses", {"mesh": sp_mesh, "heads": 8}),
+    ]:
+        model = TelemetrySequenceModel(
+            dim=32, heads=kwargs.pop("heads", 2), layers=1,
+            attention=backend, **kwargs,
+        )
+        state, tx, _ = init_seq_state(jax.random.PRNGKey(0), t, model=model)
+        step = jax.jit(lambda s, f, tt, m=model, x=tx: seq_train_step(m, x, s, f, tt))
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, feats, targets)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), backend
+        assert losses[-1] < losses[0], backend
+
+
+def test_backward_never_materializes_tt_even_unaligned():
+    """T not a multiple of the block must not degrade the backward to one
+    full (T, T) block (the gradient path pads instead)."""
+    t = 200  # not a 128 multiple
+    q, k, v = _qkv(8, b=1, h=1, t=t, d=16)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                assert (
+                    var.aval.shape[-2:] != (t, t)
+                    and var.aval.shape[-2:] != (256, 256)
+                ), f"(T,T) tensor from {eqn.primitive}"
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    # and the gradients still match the reference
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(full_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
