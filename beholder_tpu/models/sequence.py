@@ -98,13 +98,19 @@ class TelemetrySequenceModel(nn.Module):
     mesh: Mesh | None = None
     ffn: str = "dense"  # "dense" | "moe" (Switch top-1, ep-shardable)
     num_experts: int = 4
+    #: rematerialize each block's activations in the backward pass
+    #: (jax.checkpoint): trades one extra forward per block for O(layers)
+    #: less activation memory — the standard long-context lever on TPU,
+    #: where HBM, not FLOPs, is the wall
+    remat: bool = False
 
     @nn.compact
     def __call__(self, feats: jax.Array) -> jax.Array:
         """(B, T, FEATURES) -> (B, T) predicted next delta per position."""
         x = nn.Dense(self.dim, name="embed")(feats.astype(jnp.float32))
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.layers):
-            x = Block(
+            x = block_cls(
                 self.dim,
                 self.heads,
                 attention=self.attention,

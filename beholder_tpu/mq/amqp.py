@@ -316,6 +316,11 @@ class AmqpBroker(Broker):
         self._connecting = False  # loop-thread-only: one reconnect loop owner
         self._publish_buffer: list[tuple[str, bytes]] = []
 
+    @property
+    def connected(self) -> bool:
+        """Liveness probe: is the AMQP connection currently up?"""
+        return self._connected.is_set()
+
     # -- Broker -------------------------------------------------------------
     def connect(self, timeout: float = 10.0) -> None:
         if self._loop_thread is not None:

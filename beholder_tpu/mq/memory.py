@@ -36,6 +36,10 @@ class InMemoryBroker(Broker):
         self._dispatching = False
         self._log = get_logger("mq.memory")
 
+    @property
+    def connected(self) -> bool:
+        return self._connected
+
     # -- Broker ------------------------------------------------------------
     def connect(self) -> None:
         self._connected = True

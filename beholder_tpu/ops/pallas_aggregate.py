@@ -11,8 +11,9 @@ therefore REMAINS THE DEFAULT; this module is kept as a tested, working
 example of the Pallas toolchain (grid accumulation, ``pl.when`` init,
 padding, interpret-mode CPU tests) and as the starting point if the op
 ever grows a compute-bound inner loop XLA can't fuse. (The Pallas kernel
-that DOES win on TPU is :mod:`beholder_tpu.ops.flash_attention` — 1.7x
-over XLA full attention at T=4096.)
+that DOES win on TPU is :mod:`beholder_tpu.ops.flash_attention` — ~1.9x
+over XLA full attention at T=4096, causal bf16; see bench.py's
+``flash_attention_tflops`` secondary metric for the live number.)
 
 Mechanics: each grid step loads a (512, 128) tile of statuses+progress
 into VMEM and updates per-lane accumulators (count/sum/max/min per

@@ -22,6 +22,11 @@ from .pipeline import (
     stack_stage_params,
     stage_shardings,
 )
+from .zero import (
+    place_zero_state,
+    zero_state_shardings,
+    zero_train_step,
+)
 
 __all__ = [
     "make_mesh",
@@ -36,4 +41,7 @@ __all__ = [
     "stage_shardings",
     "split_microbatches",
     "merge_microbatches",
+    "place_zero_state",
+    "zero_state_shardings",
+    "zero_train_step",
 ]

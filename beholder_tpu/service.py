@@ -95,6 +95,9 @@ class BeholderService:
                 async_flush=True,  # XLA work must not stall the consumer
             )
 
+        #: set by init() when instance.health.enabled (see health.py)
+        self.health = None
+
         self._status_proto = proto.load("api.TelemetryStatus")
         self._progress_proto = proto.load("api.TelemetryProgress")
         proto.load("api.Media")  # parity with index.js:48
@@ -139,6 +142,21 @@ class BeholderService:
                 handler(delivery)
 
         return traced_handler
+
+    def close(self) -> None:
+        """Graceful teardown: stop consuming, drain analytics, close."""
+        self.logger.info("shutting down")
+        self.broker.close()
+        if self.analytics is not None:
+            try:
+                self.analytics.flush()
+                self.analytics.drain()
+            except Exception:  # noqa: BLE001 - best effort on the way out
+                pass
+        if self.health is not None:
+            self.health.close()
+        self.metrics.close()
+        self.db.close()
 
     # -- helpers -----------------------------------------------------------
     def comment(self, card_id: str, text: str) -> None:
@@ -267,20 +285,45 @@ def init(
     metrics = Metrics()
     metrics.expose(metrics_port)
 
-    db = db or SqliteStorage(os.environ.get("BEHOLDER_DB", "beholder.db"))
+    service = None
+    own_db = db is None
+    own_broker = broker is None
+    try:
+        db = db or SqliteStorage(os.environ.get("BEHOLDER_DB", "beholder.db"))
 
-    if broker is None:
-        try:
-            from beholder_tpu.mq.amqp import AmqpBroker
-        except ImportError as err:  # pragma: no cover
-            raise RuntimeError(
-                "the AMQP wire client is unavailable; pass an explicit "
-                "broker (e.g. InMemoryBroker) or fix the import"
-            ) from err
-        broker = AmqpBroker(dyn("rabbitmq"), prefetch=PREFETCH)
+        if broker is None:
+            try:
+                from beholder_tpu.mq.amqp import AmqpBroker
+            except ImportError as err:  # pragma: no cover
+                raise RuntimeError(
+                    "the AMQP wire client is unavailable; pass an explicit "
+                    "broker (e.g. InMemoryBroker) or fix the import"
+                ) from err
+            broker = AmqpBroker(dyn("rabbitmq"), prefetch=PREFETCH)
 
-    service = BeholderService(config, broker, db, metrics=metrics)
-    service.start()
+        service = BeholderService(config, broker, db, metrics=metrics)
+        service.start()
+
+        #: optional /healthz + /readyz endpoint (extension; the reference
+        #: delegates failure detection to its container orchestrator)
+        from beholder_tpu.health import health_from_config
+
+        service.health = health_from_config(config, service)
+    except Exception:
+        # a failed boot must release everything it acquired (metrics port,
+        # broker threads, the sqlite handle), or a supervised restart would
+        # hit Address-already-in-use / fd exhaustion forever. Caller-owned
+        # db/broker are the caller's to close.
+        metrics.close()
+        for resource, owned in ((broker, own_broker), (db, own_db)):
+            if owned and resource is not None:
+                try:
+                    resource.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        if service is not None and service.health is not None:
+            service.health.close()
+        raise
     return service
 
 
@@ -288,21 +331,30 @@ def main() -> None:  # pragma: no cover - process entrypoint
     import signal
     import threading
 
-    service = init()
+    import os
+
+    supervised = bool(os.environ.get("BEHOLDER_SUPERVISE"))
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+
+    if supervised:
+        # elastic recovery: crash -> exponential backoff -> rebuild, and
+        # recycle on sustained broker-liveness failure (health.py)
+        from beholder_tpu.health import Supervisor
+
+        supervisor = Supervisor(
+            init,
+            liveness=lambda svc: getattr(svc.broker, "connected", True),
+            liveness_grace_s=float(os.environ.get("BEHOLDER_LIVENESS_GRACE", 60)),
+        )
+        supervisor.start()
+        stop.wait()
+        supervisor.stop()
+        return
+
+    service = init()
     stop.wait()
-    # graceful shutdown: stop consuming, drain pending analytics, close
-    service.logger.info("shutting down")
-    service.broker.close()
-    if service.analytics is not None:
-        try:
-            service.analytics.flush()
-            service.analytics.drain()
-        except Exception:  # noqa: BLE001 - best effort on the way out
-            pass
-    service.metrics.close()
-    service.db.close()
+    service.close()
 
 
