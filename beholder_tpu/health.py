@@ -23,10 +23,15 @@ from __future__ import annotations
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Callable
 
+from beholder_tpu.httpd import serve_routes
 from beholder_tpu.log import get_logger
+
+
+def _json(code: int, body: dict) -> tuple[int, str, bytes]:
+    return code, "application/json", json.dumps(body).encode()
 
 
 class HealthServer:
@@ -78,42 +83,26 @@ class HealthServer:
 
     # -- http ---------------------------------------------------------------
     def start(self) -> int:
-        outer = self
+        def healthz():
+            healthy, checks = self.snapshot()
+            body = {
+                "status": "ok" if healthy else "unhealthy",
+                "uptime_s": round(time.time() - self._started_at, 1),
+                "checks": checks,
+            }
+            return _json(200 if healthy else 503, body)
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                path = self.path.split("?")[0]
-                if path == "/healthz":
-                    healthy, checks = outer.snapshot()
-                    body = {
-                        "status": "ok" if healthy else "unhealthy",
-                        "uptime_s": round(time.time() - outer._started_at, 1),
-                        "checks": checks,
-                    }
-                    self._json(200 if healthy else 503, body)
-                elif path == "/readyz":
-                    ready = outer.ready
-                    self._json(
-                        200 if ready else 503,
-                        {"status": "ready" if ready else "starting"},
-                    )
-                else:
-                    self.send_error(404)
+        def readyz():
+            ready = self.ready
+            return _json(
+                200 if ready else 503,
+                {"status": "ready" if ready else "starting"},
+            )
 
-            def _json(self, code: int, body: dict) -> None:
-                payload = json.dumps(body).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-            def log_message(self, *args):  # structured logs only
-                pass
-
-        self._server = ThreadingHTTPServer(("0.0.0.0", self._requested_port), Handler)
+        self._server = serve_routes(
+            {"/healthz": healthz, "/readyz": readyz}, self._requested_port
+        )
         self.port = self._server.server_address[1]
-        threading.Thread(target=self._server.serve_forever, daemon=True).start()
         return self.port
 
     def close(self) -> None:
@@ -176,7 +165,7 @@ class Supervisor:
         backoff = self.backoff_s
         while not self._stop.is_set():
             try:
-                self.service = self.factory()
+                service = self.factory()
             except Exception as err:  # noqa: BLE001 - crash -> backoff -> retry
                 self._log.warning(
                     f"service start failed: {err!r}; restarting in {backoff:.1f}s"
@@ -185,6 +174,12 @@ class Supervisor:
                     return
                 backoff = min(backoff * 2, self.backoff_max_s)
                 continue
+            self.service = service
+            if self._stop.is_set():
+                # stop() may have timed out waiting for a slow factory and
+                # already returned; this late-built service must not leak
+                self._teardown()
+                return
 
             backoff = self.backoff_s  # healthy start resets the backoff
             unhealthy_since: float | None = None

@@ -1,0 +1,42 @@
+"""Shared HTTP endpoint plumbing for the metrics and health servers.
+
+One copy of the ThreadingHTTPServer lifecycle (ephemeral-port bind,
+daemonized serve_forever thread, silenced request logging, orderly
+shutdown) so /metrics and /healthz can't drift apart on bind/shutdown
+behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+#: A route handler: () -> (status code, content type, body bytes).
+Route = Callable[[], tuple[int, str, bytes]]
+
+
+def serve_routes(routes: dict[str, Route], port: int) -> ThreadingHTTPServer:
+    """Start an HTTP server for ``routes`` (exact-path GETs) on ``port``
+    (0 = ephemeral). Returns the running server; callers own shutdown via
+    ``server.shutdown(); server.server_close()``."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            route = routes.get(self.path.split("?")[0])
+            if route is None:
+                self.send_error(404)
+                return
+            code, content_type, body = route()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # structured logs only
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

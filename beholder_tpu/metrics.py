@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import os
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Iterable
+
+from beholder_tpu.httpd import serve_routes
 
 DEFAULT_PORT = 8000
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -37,6 +39,10 @@ class Counter:
             self._values[()] = 0.0
 
     def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if not labels and not self.labelnames:  # hot path: unlabelled counter
+            with self._lock:
+                self._values[()] += amount
+            return
         if set(labels) != set(self.labelnames):
             raise ValueError(
                 f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
@@ -140,24 +146,10 @@ class Metrics:
             port = int(os.environ.get("METRICS_PORT", DEFAULT_PORT))
         registry = self.registry
 
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):
-                if self.path.split("?")[0] not in ("/metrics", "/"):
-                    self.send_error(404)
-                    return
-                payload = registry.render().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+        def render():
+            return 200, CONTENT_TYPE, registry.render().encode()
 
-            def log_message(self, *args):  # quiet: structured logs only
-                pass
-
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-        thread = threading.Thread(target=self._server.serve_forever, daemon=True)
-        thread.start()
+        self._server = serve_routes({"/metrics": render, "/": render}, port)
         return self._server.server_address[1]
 
     def close(self) -> None:

@@ -31,6 +31,7 @@ class InMemoryBroker(Broker):
         #: never scans consumer-less topics
         self._consumers: list[tuple[str, _Topic]] = []
         self._unacked: dict[int, tuple[str, bytes, dict | None]] = {}
+        self._pending_total = 0  # messages across all topic queues
         self._next_tag = 1
         self._connected = False
         self._dispatching = False
@@ -59,6 +60,7 @@ class InMemoryBroker(Broker):
         self._topics.setdefault(topic, _Topic()).pending.append(
             (bytes(body), False, headers)
         )
+        self._pending_total += 1
         if self._connected:
             self._dispatch()
 
@@ -82,7 +84,9 @@ class InMemoryBroker(Broker):
         prefetch = self.prefetch
         try:
             progressed = True
-            while progressed and len(unacked) < prefetch:
+            # _pending_total short-circuits the common publish->consume->ack
+            # cycle to ONE consumer scan (no empty second pass)
+            while progressed and self._pending_total and len(unacked) < prefetch:
                 progressed = False
                 # snapshot: a handler may listen() on a brand-new topic,
                 # mutating self._consumers mid-iteration
@@ -92,6 +96,7 @@ class InMemoryBroker(Broker):
                     if not entry.pending:
                         continue
                     body, redelivered, headers = entry.pending.popleft()
+                    self._pending_total -= 1
                     tag = self._next_tag
                     self._next_tag += 1
                     unacked[tag] = (topic, body, headers)
@@ -121,6 +126,7 @@ class InMemoryBroker(Broker):
         topic, body, headers = self._unacked.pop(tag)
         if not acked and requeue:
             self._topics[topic].pending.appendleft((body, True, headers))
+            self._pending_total += 1
         # a freed prefetch slot (or a requeue) may unblock pending work;
         # re-entrant calls return immediately and the outer loop continues
         self._dispatch()

@@ -77,6 +77,7 @@ class BeholderService:
         )
         self._emby_host = config.get("instance.emby.host")
         self._progress_counters = {}  # status text -> bound counter child
+        self._status_names = {}  # status int -> enum name (load-once enums)
 
         #: optional distributed tracing (the reference's triton-core layer
         #: carries jaeger-client — SURVEY.md §5; spans live at this layer)
@@ -180,9 +181,11 @@ class BeholderService:
         if no_trello():
             return delivery.ack()  # index.js:70-72
 
-        status_text = proto.enum_to_string(
-            self._status_proto, "TelemetryStatusEntry", status
-        )
+        status_text = self._status_names.get(status)
+        if status_text is None:
+            status_text = self._status_names[status] = proto.enum_to_string(
+                self._status_proto, "TelemetryStatusEntry", status
+            )
         media = self.db.get_by_id(media_id)
 
         # Trello card movement (index.js:79-90)
@@ -233,9 +236,11 @@ class BeholderService:
                 status,
                 progress,
             )
-            status_text = proto.enum_to_string(
-                self._progress_proto, "TelemetryStatusEntry", status
-            )
+            status_text = self._status_names.get(status)
+            if status_text is None:
+                status_text = self._status_names[status] = proto.enum_to_string(
+                    self._progress_proto, "TelemetryStatusEntry", status
+                )
 
             counter = self._progress_counters.get(status_text)
             if counter is None:
@@ -289,7 +294,14 @@ def init(
     own_db = db is None
     own_broker = broker is None
     try:
-        db = db or SqliteStorage(os.environ.get("BEHOLDER_DB", "beholder.db"))
+        if db is None:
+            target = os.environ.get("BEHOLDER_DB", "beholder.db")
+            if target.startswith(("postgres://", "postgresql://")):
+                from beholder_tpu.storage import PostgresStorage
+
+                db = PostgresStorage(target)
+            else:
+                db = SqliteStorage(target)
 
         if broker is None:
             try:
@@ -311,18 +323,25 @@ def init(
         service.health = health_from_config(config, service)
     except Exception:
         # a failed boot must release everything it acquired (metrics port,
-        # broker threads, the sqlite handle), or a supervised restart would
-        # hit Address-already-in-use / fd exhaustion forever. Caller-owned
-        # db/broker are the caller's to close.
-        metrics.close()
-        for resource, owned in ((broker, own_broker), (db, own_db)):
-            if owned and resource is not None:
-                try:
-                    resource.close()
-                except Exception:  # noqa: BLE001
-                    pass
-        if service is not None and service.health is not None:
-            service.health.close()
+        # broker threads, db handles), or a supervised restart would hit
+        # Address-already-in-use / fd exhaustion forever.
+        if service is not None:
+            # consumers are already registered with handlers bound to this
+            # service: the whole assembly must come down, INCLUDING a
+            # caller-owned broker/db (they are poisoned by the dangling
+            # registrations; a half-booted service must not keep consuming)
+            try:
+                service.close()
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            metrics.close()
+            for resource, owned in ((broker, own_broker), (db, own_db)):
+                if owned and resource is not None:
+                    try:
+                        resource.close()
+                    except Exception:  # noqa: BLE001
+                        pass
         raise
     return service
 

@@ -5,20 +5,26 @@ at /root/reference/index.js:68 and ``db.getByID(mediaId)`` at
 index.js:76,140) against an external Postgres. Backends here:
 
 - :class:`MemoryStorage` — dict-backed, for tests.
-- :class:`SqliteStorage` — durable default (psycopg2 is not in this image;
-  a Postgres backend is gated behind :func:`postgres_storage`).
+- :class:`SqliteStorage` — durable single-file default.
+- :class:`PostgresStorage` — the reference's production shape, over a
+  from-scratch v3 wire-protocol client (:mod:`.pg_wire`; no Postgres
+  driver exists in this image, so the transport is built from the spec,
+  like the AMQP stack). Tested against :class:`.pg_server.PgTestServer`
+  over real sockets.
 
 Rows are surfaced as ``api.Media`` protobuf messages so handler attribute
 access (``media.creator``, ``media.creatorId``, ...) matches the reference.
 """
 
 from .base import MediaNotFound, MemoryStorage, Storage, postgres_storage
+from .postgres import PostgresStorage
 from .sqlite import SqliteStorage
 
 __all__ = [
     "Storage",
     "MemoryStorage",
     "SqliteStorage",
+    "PostgresStorage",
     "MediaNotFound",
     "postgres_storage",
 ]

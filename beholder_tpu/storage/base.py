@@ -57,15 +57,13 @@ class MemoryStorage(Storage):
         return clone
 
 
-def postgres_storage(*_args, **_kwargs) -> Storage:
-    """Gate for the Postgres backend the reference uses (via triton-core).
+def postgres_storage(url: str, **kwargs) -> Storage:
+    """The Postgres backend the reference uses (via triton-core).
 
-    ``psycopg2`` is not available in this image, so this raises with guidance
-    rather than shipping an untestable driver.
+    Backed by the from-scratch wire client in :mod:`.pg_wire` — no
+    external driver needed. Kept as a function for callers that predate
+    :class:`.postgres.PostgresStorage`.
     """
-    raise RuntimeError(
-        "Postgres backend requires psycopg2, which is not installed in this "
-        "environment; use SqliteStorage (durable) or MemoryStorage (tests), "
-        "or install psycopg2 and contribute a PostgresStorage implementing "
-        "the same three methods."
-    )
+    from .postgres import PostgresStorage
+
+    return PostgresStorage(url, **kwargs)

@@ -1,0 +1,79 @@
+"""Postgres-backed Storage on the from-scratch wire client.
+
+The reference's production storage (index.js:19,42 via triton-core's
+``pg``). Same three-method contract as every backend here; the table is
+reconstructed from the fields the reference reads/writes
+(index.js:64,68,74-118,131-148: id, name, creator, creatorId,
+metadataId, status).
+"""
+
+from __future__ import annotations
+
+from beholder_tpu import proto
+
+from .base import MediaNotFound, Storage
+from .pg_wire import PgConnection
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS media (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL DEFAULT '',
+    creator INT NOT NULL DEFAULT 0,
+    creator_id TEXT NOT NULL DEFAULT '',
+    metadata_id TEXT NOT NULL DEFAULT '',
+    status INT NOT NULL DEFAULT 0
+)
+"""
+
+
+class PostgresStorage(Storage):
+    """``Storage`` over a real Postgres (or wire-compatible) server."""
+
+    def __init__(self, url: str, connect_timeout: float = 10.0):
+        self._conn = PgConnection(url, connect_timeout=connect_timeout)
+        self._conn.connect()
+        self._conn.execute(_SCHEMA)
+
+    def add_media(self, media: proto.Media) -> None:
+        self._conn.query(
+            "INSERT INTO media (id, name, creator, creator_id, metadata_id, status) "
+            "VALUES ($1, $2, $3, $4, $5, $6) "
+            "ON CONFLICT (id) DO UPDATE SET name = $2, creator = $3, "
+            "creator_id = $4, metadata_id = $5, status = $6",
+            (
+                media.id,
+                media.name,
+                int(media.creator),
+                media.creatorId,
+                media.metadataId,
+                int(media.status),
+            ),
+        )
+
+    def update_status(self, media_id: str, status: int) -> None:
+        _, _, tag = self._conn.query(
+            "UPDATE media SET status = $1 WHERE id = $2", (int(status), media_id)
+        )
+        if tag.endswith(" 0"):  # "UPDATE 0" — no row matched
+            raise MediaNotFound(media_id)
+
+    def get_by_id(self, media_id: str) -> proto.Media:
+        _, rows, _ = self._conn.query(
+            "SELECT id, name, creator, creator_id, metadata_id, status "
+            "FROM media WHERE id = $1",
+            (media_id,),
+        )
+        if not rows:
+            raise MediaNotFound(media_id)
+        row = rows[0]
+        return proto.Media(
+            id=row[0] or "",
+            name=row[1] or "",
+            creator=int(row[2] or 0),
+            creatorId=row[3] or "",
+            metadataId=row[4] or "",
+            status=int(row[5] or 0),
+        )
+
+    def close(self) -> None:
+        self._conn.close()

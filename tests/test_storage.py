@@ -70,6 +70,17 @@ def test_sqlite_persists_across_reopen(tmp_path):
     store2.close()
 
 
-def test_postgres_gate_explains_itself():
-    with pytest.raises(RuntimeError, match="psycopg2"):
-        postgres_storage()
+def test_postgres_gate_builds_the_wire_backend():
+    """postgres_storage() is no longer a stub: it returns the real backend
+    over the from-scratch wire client (full coverage in test_postgres.py)."""
+    from beholder_tpu.storage import PostgresStorage
+    from beholder_tpu.storage.pg_server import PgTestServer
+
+    srv = PgTestServer()
+    srv.start()
+    try:
+        db = postgres_storage(srv.url())
+        assert isinstance(db, PostgresStorage)
+        db.close()
+    finally:
+        srv.stop()
