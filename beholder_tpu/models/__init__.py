@@ -13,6 +13,13 @@ from .anomaly import (
     make_windows,
     train_step,
 )
+from .decode import (
+    decode_step,
+    forecast_deltas,
+    forecast_eta,
+    init_cache,
+    prefill,
+)
 from .sequence import (
     TelemetrySequenceModel,
     init_seq_state,
@@ -21,6 +28,11 @@ from .sequence import (
 )
 
 __all__ = [
+    "decode_step",
+    "forecast_deltas",
+    "forecast_eta",
+    "init_cache",
+    "prefill",
     "ProgressAnomalyModel",
     "make_windows",
     "init_train_state",

@@ -1,0 +1,137 @@
+"""Autoregressive inference for the sequence model: KV cache + forecast.
+
+EXTENSION BEYOND THE REFERENCE (no inference paths exist there —
+SURVEY.md §0). Completes the sequence model's lifecycle: train with
+:func:`~beholder_tpu.models.sequence.seq_train_step`, then roll the model
+forward to forecast an encode job's progress trajectory and ETA.
+
+TPU-first design:
+
+- The KV cache is a static-shape pytree ((B, H, max_len, Dh) per layer
+  plus a scalar write index); every decode step is the same compiled
+  program — ``dynamic_update_slice`` into the cache, one masked attention
+  over the full cache width, no shape change, no recompilation.
+- Prefill is ONE batched forward over the whole prefix (MXU-sized
+  matmuls), not T sequential steps; only generation runs step-by-step,
+  inside a single ``lax.scan`` so the whole rollout is one XLA program.
+- Decode attends q(1) against the cache with a position mask — the
+  flash/ring machinery is a training concern; a 1-row query is pure
+  bandwidth and XLA's fused masked softmax is already optimal for it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from beholder_tpu.ops import NUM_STATUSES
+
+from .sequence import FEATURES, TelemetrySequenceModel
+
+
+class DecodeCache(NamedTuple):
+    """Per-layer key/value tensors (B, H, max_len, Dh) + write index."""
+
+    keys: tuple
+    values: tuple
+    index: jax.Array  # scalar int32: number of positions already written
+
+
+def init_cache(
+    model: TelemetrySequenceModel, batch: int, max_len: int
+) -> DecodeCache:
+    dh = model.dim // model.heads
+    shape = (batch, model.heads, max_len, dh)
+    zeros = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
+    return DecodeCache(zeros, tuple(jnp.zeros_like(z) for z in zeros), jnp.int32(0))
+
+
+def prefill(
+    model: TelemetrySequenceModel, params, feats: jax.Array, max_len: int
+) -> tuple[jax.Array, DecodeCache]:
+    """Run the whole (B, T, F) prefix in one forward; return the last
+    position's prediction and a cache holding the prefix k/v."""
+    b, t, _ = feats.shape
+    preds, kvs = model.apply(params, feats, return_kv=True)
+    cache = init_cache(model, b, max_len)
+    keys, values = [], []
+    for (k, v), ck, cv in zip(kvs, cache.keys, cache.values):
+        keys.append(jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0)))
+        values.append(
+            jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        )
+    return preds[:, -1], DecodeCache(tuple(keys), tuple(values), jnp.int32(t))
+
+
+def decode_step(
+    model: TelemetrySequenceModel, params, cache: DecodeCache, feats_t: jax.Array
+) -> tuple[jax.Array, DecodeCache]:
+    """One autoregressive step. ``feats_t`` is (B, F); returns ((B,) next
+    prediction, updated cache). Same compiled program every step."""
+    pred, new_kvs = model.apply(
+        params, feats_t[:, None, :], cache=(cache.keys, cache.values, cache.index)
+    )
+    keys = tuple(k for k, _ in new_kvs)
+    values = tuple(v for _, v in new_kvs)
+    return pred[:, 0], DecodeCache(keys, values, cache.index + 1)
+
+
+def forecast_deltas(
+    model: TelemetrySequenceModel,
+    params,
+    progress: jax.Array,
+    statuses: jax.Array,
+    horizon: int,
+) -> jax.Array:
+    """Roll the model ``horizon`` steps past the observed stream.
+
+    ``progress``/``statuses`` are the observed (B, T+1) history (same
+    shapes as :func:`~beholder_tpu.models.sequence.stream_features`).
+    Returns (B, horizon) predicted per-step progress deltas: the model's
+    own predictions are fed back as inputs, status held at its last
+    observed value.
+    """
+    from .sequence import stream_features
+
+    feats, _ = stream_features(progress, statuses)
+    b, t, _ = feats.shape
+    max_len = t + horizon
+    last_pred, cache = prefill(model, params, feats, max_len)
+    last_status = statuses[:, -1]
+    status_oh = jax.nn.one_hot(last_status, NUM_STATUSES)  # (B, S)
+
+    def step(carry, _):
+        delta, cache = carry
+        feats_t = jnp.concatenate([delta[:, None], status_oh], axis=-1)
+        pred, cache = decode_step(model, params, cache, feats_t)
+        return (pred, cache), delta
+
+    (_, _), deltas = jax.lax.scan(
+        step, (last_pred, cache), None, length=horizon
+    )
+    return deltas.T  # (B, horizon)
+
+
+def forecast_eta(
+    model: TelemetrySequenceModel,
+    params,
+    progress: jax.Array,
+    statuses: jax.Array,
+    horizon: int,
+    target: float = 100.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Steps until each stream's forecast reaches ``target`` progress.
+
+    Returns (eta_steps (B,), reached (B,) bool). ``eta_steps`` is the
+    number of future steps until the cumulative forecast crosses the
+    target (= ``horizon`` where the forecast never gets there — check
+    ``reached``).
+    """
+    deltas = forecast_deltas(model, params, progress, statuses, horizon)
+    future = progress[:, -1:] + jnp.cumsum(deltas, axis=-1)  # (B, horizon)
+    hit = future >= target
+    reached = jnp.any(hit, axis=-1)
+    eta = jnp.where(reached, jnp.argmax(hit, axis=-1) + 1, horizon)
+    return eta, reached

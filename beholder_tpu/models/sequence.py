@@ -55,7 +55,10 @@ class Block(nn.Module):
     num_experts: int = 4
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
+        """Training/scoring forward, or — with ``cache=(k, v, index)`` —
+        one KV-cached decode step on a (B, 1, D) input (see
+        :mod:`beholder_tpu.models.decode`)."""
         b, t, d = x.shape
         h = self.heads
         y = nn.LayerNorm()(x)
@@ -65,16 +68,36 @@ class Block(nn.Module):
         q, k, v = (
             a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3) for a in (q, k, v)
         )
-        if self.attention in ("ring", "ulysses") and self.mesh is None:
-            raise ValueError(f"{self.attention} attention needs a mesh")
-        if self.attention == "ring":
-            att = ring_attention(q, k, v, self.mesh, causal=True)
-        elif self.attention == "ulysses":
-            att = ulysses_attention(q, k, v, self.mesh, causal=True)
-        elif self.attention == "flash":
-            att = flash_attention(q, k, v, causal=True)
+        if cache is not None:
+            k_cache, v_cache, index = cache
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
+            )
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+            ) / jnp.sqrt(jnp.float32(d // h))
+            positions = jnp.arange(k_cache.shape[2])
+            scores = jnp.where(positions <= index, scores, -1e30)
+            weights = jax.nn.softmax(scores, axis=-1)
+            att = jnp.einsum(
+                "bhqk,bhkd->bhqd", weights, v_cache.astype(jnp.float32)
+            ).astype(q.dtype)
+            kv_out = (k_cache, v_cache)
         else:
-            att = full_attention(q, k, v, causal=True)
+            if self.attention in ("ring", "ulysses") and self.mesh is None:
+                raise ValueError(f"{self.attention} attention needs a mesh")
+            if self.attention == "ring":
+                att = ring_attention(q, k, v, self.mesh, causal=True)
+            elif self.attention == "ulysses":
+                att = ulysses_attention(q, k, v, self.mesh, causal=True)
+            elif self.attention == "flash":
+                att = flash_attention(q, k, v, causal=True)
+            else:
+                att = full_attention(q, k, v, causal=True)
+            kv_out = (k, v)
         att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
 
@@ -85,6 +108,8 @@ class Block(nn.Module):
             y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
             y = nn.gelu(y)
             x = x + nn.Dense(d, name="down", dtype=jnp.bfloat16)(y).astype(x.dtype)
+        if cache is not None or return_kv:
+            return x, kv_out
         return x
 
 
@@ -105,12 +130,18 @@ class TelemetrySequenceModel(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, feats: jax.Array) -> jax.Array:
-        """(B, T, FEATURES) -> (B, T) predicted next delta per position."""
+    def __call__(self, feats: jax.Array, cache=None, return_kv: bool = False):
+        """(B, T, FEATURES) -> (B, T) predicted next delta per position.
+
+        With ``cache=(keys, values, index)`` (per-layer tuples) this is a
+        KV-cached decode step; with ``return_kv=True`` the per-layer
+        (k, v) tensors come back alongside the predictions (prefill).
+        """
         x = nn.Dense(self.dim, name="embed")(feats.astype(jnp.float32))
         block_cls = nn.remat(Block) if self.remat else Block
+        kvs = []
         for i in range(self.layers):
-            x = block_cls(
+            block = block_cls(
                 self.dim,
                 self.heads,
                 attention=self.attention,
@@ -118,9 +149,20 @@ class TelemetrySequenceModel(nn.Module):
                 ffn=self.ffn,
                 num_experts=self.num_experts,
                 name=f"block_{i}",
-            )(x)
+            )
+            if cache is not None:
+                x, kv = block(x, cache=(cache[0][i], cache[1][i], cache[2]))
+                kvs.append(kv)
+            elif return_kv:
+                x, kv = block(x, return_kv=True)
+                kvs.append(kv)
+            else:
+                x = block(x)
         x = nn.LayerNorm()(x)
-        return nn.Dense(1, name="head", dtype=jnp.float32)(x)[..., 0]
+        preds = nn.Dense(1, name="head", dtype=jnp.float32)(x)[..., 0]
+        if cache is not None or return_kv:
+            return preds, kvs
+        return preds
 
 
 def stream_features(progress: jax.Array, statuses: jax.Array) -> tuple[jax.Array, jax.Array]:
