@@ -53,6 +53,7 @@ class Block(nn.Module):
     mesh: Mesh | None = None
     ffn: str = "dense"  # "dense" | "moe"
     num_experts: int = 4
+    moe_topk: int = 1  # 1 = Switch, 2 = GShard top-2
 
     @nn.compact
     def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
@@ -62,8 +63,13 @@ class Block(nn.Module):
         b, t, d = x.shape
         h = self.heads
         y = nn.LayerNorm()(x)
-        qkv = nn.Dense(3 * d, name="qkv", dtype=jnp.bfloat16)(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # separate q/k/v projections (not one packed 3d Dense): with
+        # megatron column sharding P(None, "tp") each tp shard then holds
+        # whole heads of each of q, k, v — a packed kernel's thirds would
+        # straddle shard boundaries and force resharding before attention
+        q = nn.Dense(d, name="q_proj", dtype=jnp.bfloat16)(y)
+        k = nn.Dense(d, name="k_proj", dtype=jnp.bfloat16)(y)
+        v = nn.Dense(d, name="v_proj", dtype=jnp.bfloat16)(y)
         # (B, T, D) -> (B, H, T, Dh): leading dims pass through attention
         q, k, v = (
             a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3) for a in (q, k, v)
@@ -76,15 +82,20 @@ class Block(nn.Module):
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
             )
+            # Same dtype mix as ops.attention.full_attention (the training
+            # forward): score matmul in the cache dtype (bf16 on the MXU),
+            # f32 softmax, weights cast back before the PV matmul — so
+            # incremental decode reproduces the full causal forward bit-for
+            # -bit up to accumulation order.
             scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+                "bhqd,bhkd->bhqk", q.astype(k_cache.dtype), k_cache
             ) / jnp.sqrt(jnp.float32(d // h))
             positions = jnp.arange(k_cache.shape[2])
             scores = jnp.where(positions <= index, scores, -1e30)
-            weights = jax.nn.softmax(scores, axis=-1)
+            weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
             att = jnp.einsum(
-                "bhqk,bhkd->bhqd", weights, v_cache.astype(jnp.float32)
-            ).astype(q.dtype)
+                "bhqk,bhkd->bhqd", weights.astype(q.dtype), v_cache
+            )
             kv_out = (k_cache, v_cache)
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
@@ -103,7 +114,10 @@ class Block(nn.Module):
 
         y = nn.LayerNorm()(x)
         if self.ffn == "moe":
-            x = x + SwitchFFN(d, 4 * d, self.num_experts, name="moe")(y)
+            x = x + SwitchFFN(
+                d, 4 * d, self.num_experts, name="moe",
+                router_topk=self.moe_topk, mesh=self.mesh,
+            )(y)
         else:
             y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
             y = nn.gelu(y)
@@ -121,8 +135,9 @@ class TelemetrySequenceModel(nn.Module):
     layers: int = 2
     attention: str = "full"
     mesh: Mesh | None = None
-    ffn: str = "dense"  # "dense" | "moe" (Switch top-1, ep-shardable)
+    ffn: str = "dense"  # "dense" | "moe" (Switch/GShard, ep-shardable)
     num_experts: int = 4
+    moe_topk: int = 1  # 1 = Switch, 2 = GShard top-2
     #: rematerialize each block's activations in the backward pass
     #: (jax.checkpoint): trades one extra forward per block for O(layers)
     #: less activation memory — the standard long-context lever on TPU,
@@ -138,7 +153,12 @@ class TelemetrySequenceModel(nn.Module):
         (k, v) tensors come back alongside the predictions (prefill).
         """
         x = nn.Dense(self.dim, name="embed")(feats.astype(jnp.float32))
-        block_cls = nn.remat(Block) if self.remat else Block
+        # remat only pays off in the training backward; the decode/prefill
+        # paths route a cache pytree and a Python-bool return_kv through
+        # the block, which jax.checkpoint would trace (breaking the
+        # `cache is not None or return_kv` branch) — use the plain class
+        decoding = cache is not None or return_kv
+        block_cls = nn.remat(Block) if (self.remat and not decoding) else Block
         kvs = []
         for i in range(self.layers):
             block = block_cls(
@@ -148,6 +168,7 @@ class TelemetrySequenceModel(nn.Module):
                 mesh=self.mesh,
                 ffn=self.ffn,
                 num_experts=self.num_experts,
+                moe_topk=self.moe_topk,
                 name=f"block_{i}",
             )
             if cache is not None:
@@ -181,6 +202,7 @@ def stream_features(progress: jax.Array, statuses: jax.Array) -> tuple[jax.Array
 
 
 AUX_LOSS_WEIGHT = 0.01  # standard Switch load-balance coefficient
+Z_LOSS_WEIGHT = 1e-3  # ST-MoE router z-loss coefficient
 
 
 def seq_loss(model: TelemetrySequenceModel, params, feats, targets) -> jax.Array:
@@ -188,9 +210,18 @@ def seq_loss(model: TelemetrySequenceModel, params, feats, targets) -> jax.Array
     err = (pred - targets) ** 2
     mask = jnp.ones_like(err).at[:, -1].set(0.0)  # last target is padding
     loss = (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    # MoE blocks sow per-layer load-balance losses; dense models sow nothing
-    for aux in jax.tree.leaves(sown):
-        loss = loss + AUX_LOSS_WEIGHT * aux
+    # MoE blocks sow per-layer router terms by name; dense models sow
+    # nothing. drop_fraction is a health METRIC, never a loss term.
+    from jax.tree_util import tree_flatten_with_path
+
+    from beholder_tpu.parallel.sharding import path_key_names
+
+    for path, leaf in tree_flatten_with_path(sown)[0]:
+        names = path_key_names(path)
+        if "aux_loss" in names:
+            loss = loss + AUX_LOSS_WEIGHT * leaf
+        elif "router_z_loss" in names:
+            loss = loss + Z_LOSS_WEIGHT * leaf
     return loss
 
 

@@ -25,6 +25,8 @@ one block) and on the virtual CPU mesh used by the tests.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -91,47 +93,150 @@ def ring_attention(
 
     Inputs are (..., T, d) global arrays; T must divide evenly by the axis
     size. Output matches :func:`full_attention` up to float tolerance.
+
+    Memory: O(T/P * d) per device in BOTH passes. The forward saves only
+    (q, k, v, o, logsumexp) — all O(T/P * d) shards — and the custom VJP
+    re-rotates k/v around the ring, recomputing each (T/P, T/P)
+    probability block transiently from the saved per-row logsumexp (the
+    flash backward, distributed). dk/dv partial sums travel WITH their
+    blocks and complete a full ring circle, arriving home with every
+    device's contribution accumulated.
     """
     p_size = mesh.shape[axis]
     t = q.shape[-2]
     if t % p_size:
         raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
-    block = t // p_size
+    return _ring_vjp(mesh, axis, causal, q.ndim)(q, k, v)
 
-    def local(qb, kb, vb):
-        idx = jax.lax.axis_index(axis)
-        q_offset = idx * block
 
-        m = jnp.full(qb.shape[:-1], _NEG_INF, jnp.float32)
-        l = jnp.zeros(qb.shape[:-1], jnp.float32)
-        o = jnp.zeros(qb.shape, jnp.float32)
-        kc, vc, kv_idx = kb, vb, idx
+def _ring_local_fwd(qb, kb, vb, *, axis, p_size, block, causal, want_lse):
+    """Per-device forward: online-softmax over p_size ring rotations.
 
-        # static unroll over the (known) ring size: p_size block attends
-        # with p_size-1 rotations — the last block needs no further hop,
-        # and XLA overlaps each ppermute with the next step's compute
-        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
-        for step in range(p_size):
-            blk = _block_attend(qb, kc, vc, q_offset, kv_idx * block, causal)
-            m, l, o = _combine((m, l, o), blk)
-            if step < p_size - 1:
-                kc = jax.lax.ppermute(kc, axis, perm)
-                vc = jax.lax.ppermute(vc, axis, perm)
-                kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+    Returns (o, lse) where lse is the per-row logsumexp the backward
+    needs to recompute probabilities exactly.
+    """
+    idx = jax.lax.axis_index(axis)
+    q_offset = idx * block
 
-        # under causal self-attention every row sees at least its own
-        # position, so l >= 1 always; divide directly
-        return (o / l[..., None]).astype(q.dtype)
+    m = jnp.full(qb.shape[:-1], _NEG_INF, jnp.float32)
+    l = jnp.zeros(qb.shape[:-1], jnp.float32)
+    o = jnp.zeros(qb.shape, jnp.float32)
+    kc, vc, kv_idx = kb, vb, idx
 
-    spec = P(*([None] * (q.ndim - 2)), axis, None)
-    sharded = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
-    return sharded(q, k, v)
+    # static unroll over the (known) ring size: p_size block attends
+    # with p_size-1 rotations — the last block needs no further hop,
+    # and XLA overlaps each ppermute with the next step's compute
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    for step in range(p_size):
+        blk = _block_attend(qb, kc, vc, q_offset, kv_idx * block, causal)
+        m, l, o = _combine((m, l, o), blk)
+        if step < p_size - 1:
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+
+    # under causal self-attention every row sees at least its own
+    # position, so l >= 1 always; divide directly
+    out = (o / l[..., None]).astype(qb.dtype)
+    if not want_lse:
+        return out
+    return out, m + jnp.log(jnp.maximum(l, 1e-37))
+
+
+def _ring_local_bwd(qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal):
+    """Per-device flash-style backward over a second ring pass.
+
+    dq accumulates locally; (dk, dv) partials rotate alongside their k/v
+    block for a FULL circle (p_size hops), so each block's gradient
+    arrives back at its owner with all devices' contributions.
+    """
+    d = qb.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    idx = jax.lax.axis_index(axis)
+    q_offset = idx * block
+    dof = dob.astype(jnp.float32)
+    delta = jnp.sum(dof * ob.astype(jnp.float32), axis=-1)  # (..., T/P)
+
+    dq = jnp.zeros(qb.shape, jnp.float32)
+    kc, vc, kv_idx = kb, vb, idx
+    dkc = jnp.zeros(kb.shape, jnp.float32)
+    dvc = jnp.zeros(vb.shape, jnp.float32)
+
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    for step in range(p_size):
+        kv_offset = kv_idx * block
+        s = jnp.einsum("...qd,...kd->...qk", qb, kc).astype(jnp.float32) * scale
+        if causal:
+            tq, tk = qb.shape[-2], kc.shape[-2]
+            rows = q_offset + jnp.arange(tq)[:, None]
+            cols = kv_offset + jnp.arange(tk)[None, :]
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])        # transient (T/P, T/P) block
+        dvc = dvc + jnp.einsum("...qk,...qd->...kd", p, dof)
+        dp = jnp.einsum("...qd,...kd->...qk", dof, vc.astype(jnp.float32))
+        ds = (p * (dp - delta[..., None]) * scale).astype(qb.dtype)
+        dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kc).astype(jnp.float32)
+        dkc = dkc + jnp.einsum("...qk,...qd->...kd", ds.astype(jnp.float32), qb.astype(jnp.float32))
+        if step < p_size - 1:
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+        # gradient partials always hop — p_size hops = full circle home
+        dkc = jax.lax.ppermute(dkc, axis, perm)
+        dvc = jax.lax.ppermute(dvc, axis, perm)
+
+    return dq.astype(qb.dtype), dkc.astype(kb.dtype), dvc.astype(vb.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
+    """custom-VJP ring attention bound to (mesh, axis, causal, rank)."""
+    p_size = mesh.shape[axis]
+    spec = P(*([None] * (ndim - 2)), axis, None)
+    lse_spec = P(*([None] * (ndim - 2)), axis)
+
+    def shard(fn, in_specs, out_specs):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        block = q.shape[-2] // p_size
+        return shard(
+            functools.partial(
+                _ring_local_fwd, axis=axis, p_size=p_size, block=block,
+                causal=causal, want_lse=False,
+            ),
+            (spec, spec, spec), spec,
+        )(q, k, v)
+
+    def f_fwd(q, k, v):
+        block = q.shape[-2] // p_size
+        o, lse = shard(
+            functools.partial(
+                _ring_local_fwd, axis=axis, p_size=p_size, block=block,
+                causal=causal, want_lse=True,
+            ),
+            (spec, spec, spec), (spec, lse_spec),
+        )(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def f_bwd(res, do):
+        q, k, v, o, lse = res
+        block = q.shape[-2] // p_size
+        return shard(
+            functools.partial(
+                _ring_local_bwd, axis=axis, p_size=p_size, block=block,
+                causal=causal,
+            ),
+            (spec, spec, spec, spec, lse_spec, spec),
+            (spec, spec, spec),
+        )(q, k, v, o, lse, do)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
 
 
 def ulysses_attention(

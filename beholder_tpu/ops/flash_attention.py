@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel (forward) + blocked XLA backward.
+"""Flash attention as Pallas TPU kernels (forward AND backward).
 
 EXTENSION BEYOND THE REFERENCE (which has no attention or tensors of any
 kind — SURVEY.md §0/§5). This is the single-device fast path behind the
@@ -8,22 +8,33 @@ recurrence across chips.
 
 Design (see /opt/skills/guides/pallas_guide.md):
 
-- Forward kernel: grid over (batch*heads, q blocks). Each step holds one
-  (block_q, d) q tile plus the full (T, d) k/v for its batch-head in VMEM
-  and runs the online-softmax recurrence over k/v blocks with a
-  ``fori_loop`` — running max m, normalizer l, and unnormalized
-  accumulator — so the (T, T) score matrix never exists. For causal
-  masking the loop stops after the q block's diagonal.
-- The kernel also emits the row logsumexp, which makes the backward
+- Forward kernel: grid (batch*heads, q blocks, kv blocks), kv innermost.
+  Each q tile stays resident while (block_k, d) k/v tiles STREAM through
+  VMEM — Pallas double-buffers the next tile's DMA behind the current
+  tile's compute, so VMEM holds O(block) rows regardless of T. The
+  online-softmax state (running max m, normalizer l, f32 accumulator)
+  lives in VMEM scratch across the kv grid steps.
+- All matmuls run in the INPUT dtype on the MXU with float32
+  accumulation (``preferred_element_type``): bf16 inputs use the MXU's
+  double-rate bf16 path, exactly matching ``full_attention``'s dtype mix
+  (bf16 score matmul, f32 softmax, bf16 probability @ v).
+- Causal masking skips work at block granularity: fully-masked kv blocks
+  clamp their BlockSpec index to the diagonal (same index as the previous
+  step -> Pallas skips the DMA entirely) and ``pl.when`` skips the
+  compute, so the causal forward does ~half the work of the full grid.
+- The kernel emits the per-row logsumexp, making the backward
   recomputation exact.
-- Backward: a custom-VJP rule in blocked XLA (scan over k/v blocks,
-  recomputing probabilities from the saved logsumexp — the standard flash
-  backward). Memory stays O(T * block) instead of O(T^2); XLA keeps the
-  einsums on the MXU.
+- Backward: TWO Pallas kernels with the same streaming discipline —
+  one accumulates dq over kv blocks (q tile resident), one accumulates
+  dk/dv over q blocks (kv tile resident) — recomputing probabilities
+  from the saved logsumexp (the standard flash backward). Memory stays
+  O(T * block) end to end; the (T, T) matrix never exists in either
+  pass.
 - Head dim is zero-padded to the 128-lane width and T to a block
-  multiple; padded k/v columns are masked with -inf so they contribute
-  nothing, and padded d columns contribute zeros to every dot product.
-- On non-TPU backends the kernel runs in interpreter mode, so the same
+  multiple; padded kv columns are masked with -inf so they contribute
+  nothing, padded q rows carry zero cotangents, and padded d columns
+  contribute zeros to every dot product.
+- On non-TPU backends the kernels run in interpreter mode, so the same
   code path is exercised by the CPU-mesh tests.
 """
 
@@ -34,95 +45,369 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LANES = 128
-_BLOCK = 128  # q/kv block rows; also the T padding granule
+_MIN_BLOCK = 128   # T padding granule; smallest tile
+_MAX_BLOCK = 1024  # preferred q/kv block rows when T allows
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, t_real, causal, scale):
-    """One (block_q, d) q tile against all k/v blocks of its batch-head."""
+def _pick_block(t_pad: int) -> int:
+    """Largest power-of-two block in [128, 512] dividing t_pad."""
+    b = _MAX_BLOCK
+    while b > _MIN_BLOCK and t_pad % b:
+        b //= 2
+    return b
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+# kv sub-chunk rows inside one grid step. Empirically on v5e the
+# monolithic block (sub == block) wins: Mosaic does not overlap the
+# 1-ahead pipelined chunks, and per-chunk softmax-state updates cost
+# more VPU work than the overlap recovers (27.1 vs 17-22 TFLOP/s).
+_SUB = 1024
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+    *, t_real, t_pad, causal, scale, block,
+):
+    """One (block, d) q tile x one streamed (block, d) kv tile.
+
+    The kv tile is processed as unrolled _SUB-row chunks so Mosaic can
+    overlap each chunk's softmax (VPU) with the next chunk's score
+    matmul (MXU); at d=128 flash attention is VPU-bound otherwise.
+    Masking is only computed where it can bite: the causal diagonal
+    block and (when T was padded) the last kv block — interior blocks
+    skip the iota/compare/select entirely.
+    """
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-    bq, d = q.shape
-    t_pad = k_ref.shape[1]
-    n_kv = t_pad // _BLOCK
-    rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, _BLOCK), 0)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * _BLOCK, _BLOCK), :]
-        vb = v_ref[0, pl.ds(j * _BLOCK, _BLOCK), :]
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    sub = min(_SUB, block)
+    n_sub = block // sub
+
+    def _chunks(masked: bool):
+        # fold the softmax scale into q once per tile — one (bq, d) pass
+        # instead of a (bq, bk) f32 multiply per kv block
+        q = (q_ref[0].astype(jnp.float32) * scale).astype(q_ref.dtype)
+
+        def score(j2):
+            kc = k_ref[0, j2 * sub:(j2 + 1) * sub, :]
+            s = jax.lax.dot_general(
+                q, kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                              # (bq, sub) f32
+            if masked:
+                rows = qi * block + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, sub), 0
+                )
+                cols = kj * block + j2 * sub + jax.lax.broadcasted_iota(
+                    jnp.int32, (block, sub), 1
+                )
+                valid = cols < t_real
+                if causal:
+                    valid = valid & (rows >= cols)
+                s = jnp.where(valid, s, _NEG_INF)
+            return s
+
+        # 1-ahead software pipeline: the NEXT chunk's score matmul is
+        # issued to the MXU before this chunk's softmax runs on the VPU,
+        # so the two units overlap instead of serializing
+        s = score(0)
+        for j2 in range(n_sub):
+            s_next = score(j2 + 1) if j2 + 1 < n_sub else None
+            vc = v_ref[0, j2 * sub:(j2 + 1) * sub, :]
+            m_prev = m_ref[:, :1]          # (bq, 1); lanes hold copies
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)         # (bq, sub) f32
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = jnp.broadcast_to(
+                l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+                l_ref.shape,
+            )
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p.astype(vc.dtype), vc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            s = s_next
+
+    # causal: kv blocks strictly past the q tile's diagonal are fully
+    # masked — their BlockSpec index was clamped (no DMA), skip compute
+    live = (qi >= kj) if causal else True
+    needs_mask = (qi == kj) if causal else False
+    if t_pad != t_real:
+        needs_mask = needs_mask | (kj == n_kv - 1)
+    if needs_mask is False:
+        pl.when(live)(lambda: _chunks(False))
+    else:
+        pl.when(live & needs_mask)(lambda: _chunks(True))
+        pl.when(live & jnp.logical_not(needs_mask))(lambda: _chunks(False))
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        m = m_ref[:, :1]
+        safe_l = jnp.maximum(l, 1e-37)     # fully-masked (padded) rows: l=0
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
+        # per-q-row logsumexp lives on the SUBLANE dim with 128 lanes of
+        # copies (the official TPU flash layout): the backward can read a
+        # (block, 1) column directly, no in-kernel transpose
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
+)
+def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
+    """(BH, T_pad, d_pad) inputs -> (o, lse) with the same padding."""
+    bh, t_pad, d_pad = q.shape
+    block = _pick_block(t_pad)
+    n_blk = t_pad // block
+
+    if causal:
+        # clamp fully-masked kv blocks to the diagonal: same index as the
+        # previous grid step -> Pallas skips the DMA
+        kv_map = lambda b, i, j: (b, jnp.minimum(j, i), 0)
+    else:
+        kv_map = lambda b, i, j: (b, j, 0)
+
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, t_real=t_real, t_pad=t_pad, causal=causal,
+            scale=scale, block=block,
+        ),
+        grid=(bh, n_blk, n_blk),
+        in_specs=[
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block, d_pad), kv_map),
+            pl.BlockSpec((1, block, d_pad), kv_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block, _LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block, _LANES), jnp.float32),  # l
+            pltpu.VMEM((block, d_pad), jnp.float32),   # acc
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward: dq kernel (q tile resident, kv streams)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, t_real, causal, scale, block,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    live = (qi >= kj) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        kb = k_ref[0]
         s = jax.lax.dot_general(
-            q,
-            kb.astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
+            q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (bq, BLOCK)
-        cols = j * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, (bq, _BLOCK), 1)
+        ) * scale
+        rows = qi * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0
+        )
+        cols = kj * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1
+        )
         valid = cols < t_real
         if causal:
             valid = valid & (rows >= cols)
         s = jnp.where(valid, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        scale_old = jnp.exp(m - m_new)
-        l_new = l * scale_old + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * scale_old + jax.lax.dot_general(
-            p,
-            vb.astype(jnp.float32),
-            (((1,), (0,)), ((), ())),
+        # p: exact probabilities recomputed from the saved logsumexp
+        # (padded q rows carry lse=+BIG so p underflows to exactly 0)
+        p = jnp.exp(s - lse_ref[0][:, :1])             # (bq, bk) f32
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # (bq, bk) f32
+        ds = p * (dp - delta_ref[0][:, :1]) * scale     # (bq, bk) f32
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    if causal:
-        # blocks past the diagonal are fully masked; skip them. bq ==
-        # _BLOCK always (T is padded to a block multiple), so q tile qi's
-        # diagonal k/v block is exactly block qi.
-        hi = jnp.minimum(n_kv, qi + 1)
-    else:
-        hi = n_kv
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-
-    # fully-masked rows (q padding) have l=0; emit 0 output, -inf lse
-    safe_l = jnp.maximum(l, 1e-37)
-    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    lse = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(safe_l[:, 0]), _NEG_INF)
-    # lse is broadcast over 8 sublanes purely to satisfy the (8, 128) f32
-    # tile rule for output blocks; the wrapper reads sublane 0
-    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "interpret", "t_real", "scale"))
-def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
-    """(BH, T_pad, d_pad) inputs -> (o, lse) with the same padding."""
+# ---------------------------------------------------------------------------
+# backward: dk/dv kernel (kv tile resident, q streams)
+# ---------------------------------------------------------------------------
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, t_real, causal, scale, block,
+):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (qi >= kj) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]
+        kb = k_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        rows = qi * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0
+        )
+        cols = kj * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1
+        )
+        valid = cols < t_real
+        if causal:
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk) f32
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                               # (bk, d)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
+)
+def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
+    """Padded (BH, T_pad, d_pad) residuals + cotangent -> (dq, dk, dv)."""
     bh, t_pad, d_pad = q.shape
-    grid = (bh, t_pad // _BLOCK)
-    o, lse = pl.pallas_call(
+    block = _pick_block(t_pad)
+    n_blk = t_pad // block
+
+    # delta_i = sum_d do_i * o_i — one cheap fused XLA pass. Both lse and
+    # delta take the lane-broadcast (BH, T_pad, 128) layout so the kernels
+    # read a (block, 1) sublane column with no transpose.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t_pad, _LANES))
+    lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t_pad, _LANES))
+
+    q_res = lambda b, i, j: (b, i, 0)        # follows the resident tile
+    if causal:
+        kv_stream = lambda b, i, j: (b, jnp.minimum(j, i), 0)
+    else:
+        kv_stream = lambda b, i, j: (b, j, 0)
+
+    tile = lambda index_map: pl.BlockSpec((1, block, d_pad), index_map)
+    rows = lambda index_map: pl.BlockSpec((1, block, _LANES), index_map)
+
+    dq = pl.pallas_call(
         functools.partial(
-            _flash_kernel, t_real=t_real, causal=causal, scale=scale
+            _dq_kernel, t_real=t_real, causal=causal, scale=scale, block=block
         ),
-        grid=grid,
+        grid=(bh, n_blk, n_blk),
         in_specs=[
-            pl.BlockSpec((1, _BLOCK, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_pad, d_pad), lambda b, i: (b, 0, 0)),
+            tile(q_res), tile(kv_stream), tile(kv_stream),
+            tile(q_res), rows(q_res), rows(q_res),
         ],
-        out_specs=[
-            pl.BlockSpec((1, _BLOCK, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 8, _BLOCK), lambda b, i: (b, 0, i)),
+        out_specs=tile(q_res),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse_b, delta_b)
+
+    kv_res = lambda b, j, i: (b, j, 0)       # resident kv tile
+    if causal:
+        # q blocks before the kv tile's diagonal are fully masked; clamp
+        # to the first contributing block (no DMA for the skipped steps)
+        q_stream = lambda b, j, i: (b, jnp.maximum(i, j), 0)
+    else:
+        q_stream = lambda b, j, i: (b, i, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, t_real=t_real, causal=causal, scale=scale, block=block
+        ),
+        grid=(bh, n_blk, n_blk),
+        in_specs=[
+            tile(q_stream), tile(kv_res), tile(kv_res),
+            tile(q_stream), rows(q_stream), rows(q_stream),
         ],
+        out_specs=[tile(kv_res), tile(kv_res)],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, t_pad), jnp.float32),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, d_pad), jnp.float32),
+            pltpu.VMEM((block, d_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
-    return o, lse[:, 0, :]
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP wrapper
+# ---------------------------------------------------------------------------
 
 
 def _pad_to(x, t_pad, d_pad):
@@ -137,13 +422,13 @@ def _flash(q, k, v, causal):
 
 def _flash_fwd_res(q, k, v, causal):
     bh, t, d = q.shape
-    t_pad = -(-t // _BLOCK) * _BLOCK
+    t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
     d_pad = -(-d // _LANES) * _LANES
     scale = float(1.0 / (d**0.5))
-    interpret = jax.devices()[0].platform != "tpu"
     qp, kp, vp = (_pad_to(a, t_pad, d_pad) for a in (q, k, v))
     o, lse = _flash_fwd_padded(
-        qp, kp, vp, causal=causal, interpret=interpret, t_real=t, scale=scale
+        qp, kp, vp, causal=causal, interpret=_interpret(), t_real=t,
+        scale=scale,
     )
     return o[:, :t, :d], lse[:, :t]
 
@@ -154,56 +439,22 @@ def _flash_fwd(q, k, v, causal):
 
 
 def _flash_bwd(causal, res, do):
-    """Blocked flash backward in XLA: scan over k/v blocks, recomputing
-    probabilities from the saved logsumexp. O(T * block) memory."""
     q, k, v, o, lse = res
     bh, t, d = q.shape
-    scale = 1.0 / (d**0.5)
-
-    # pad T to a block multiple (same discipline as the forward) so the
-    # scan below never degenerates to one full (T, T) block. Padded q rows
-    # get lse=+BIG so their probabilities underflow to exactly 0 (an -inf
-    # pad would make exp(0 - lse) blow up); padded k/v columns are masked
-    # in the scores; padded do/o rows are zero so every gradient term from
-    # padding vanishes.
-    block = min(_BLOCK, t)
-    t_pad = -(-t // block) * block
-    pad = ((0, 0), (0, t_pad - t), (0, 0))
-    qf = jnp.pad(q.astype(jnp.float32), pad)
-    do_f = jnp.pad(do.astype(jnp.float32), pad)
-    of = jnp.pad(o.astype(jnp.float32), pad)
-    kf = jnp.pad(k.astype(jnp.float32), pad)
-    vf = jnp.pad(v.astype(jnp.float32), pad)
+    t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
+    d_pad = -(-d // _LANES) * _LANES
+    scale = float(1.0 / (d**0.5))
+    qp, kp, vp, op, dop = (_pad_to(a, t_pad, d_pad) for a in (q, k, v, o, do))
+    # padded q rows get lse=+BIG so their recomputed probabilities
+    # underflow to exactly 0 (an -inf pad would make exp(0 - lse) blow
+    # up: padded q rows are zeros, not masked, so their s entries are 0);
+    # their cotangent rows are zero-padded too, killing every grad term
     lse_p = jnp.pad(lse, ((0, 0), (0, t_pad - t)), constant_values=1e30)
-
-    delta = jnp.sum(do_f * of, axis=-1)  # (BH, T_pad)
-    rows = jnp.arange(t_pad)
-
-    n_blocks = t_pad // block
-    kb = kf.reshape(bh, n_blocks, block, d).transpose(1, 0, 2, 3)
-    vb = vf.reshape(bh, n_blocks, block, d).transpose(1, 0, 2, 3)
-
-    def body(dq, blk):
-        j, kj, vj = blk
-        cols = j * block + jnp.arange(block)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kj) * scale
-        valid = (cols < t)[None, :]
-        if causal:
-            valid = valid & (rows[:, None] >= cols[None, :])
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse_p[..., None])  # masked/-inf entries -> exactly 0
-        dv_j = jnp.einsum("bqk,bqd->bkd", p, do_f)
-        dp = jnp.einsum("bqd,bkd->bqk", do_f, vj)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kj)
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
-        return dq, (dk_j, dv_j)
-
-    dq0 = jnp.zeros_like(qf)
-    dq, (dk_b, dv_b) = jax.lax.scan(body, dq0, (jnp.arange(n_blocks), kb, vb))
-    dk = dk_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
-    dv = dv_b.transpose(1, 0, 2, 3).reshape(bh, t_pad, d)[:, :t]
-    return dq[:, :t].astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq, dk, dv = _flash_bwd_padded(
+        qp, kp, vp, op, lse_p, dop, causal=causal, interpret=_interpret(),
+        t_real=t, scale=scale,
+    )
+    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

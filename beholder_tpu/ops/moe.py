@@ -56,6 +56,20 @@ class SwitchFFN(nn.Module):
     #: tokens per routing group; capacity is enforced within each group so
     #: dispatch memory is O(N·capacity_factor·group_size), linear in N
     group_size: int = 1024
+    #: top-k routing: 1 = Switch, 2 = GShard-style top-2 (second choice
+    #: queues behind every first choice in the group)
+    router_topk: int = 1
+    #: when set (and the mesh has ``ep_axis``), the layer follows the
+    #: GShard dispatch layout: routing groups sharded over ``token_axes``,
+    #: expert tensors sharded over ``ep_axis``, with sharding constraints
+    #: on both sides of the exchange so GSPMD lowers it to an ALL-TO-ALL
+    #: over ``ep`` instead of all-gathering tokens or expert weights
+    #: (verified in tests/test_moe.py::test_ep_dispatch_lowers_to_all_to_all)
+    mesh: Mesh | None = None
+    ep_axis: str = "ep"
+    #: mesh axes the token/group dim is sharded over (filtered to axes the
+    #: mesh actually has); groups are padded to a multiple of their shards
+    token_axes: tuple = ("dp", "ep")
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -67,14 +81,45 @@ class SwitchFFN(nn.Module):
         # token, so within the one partial group their cumsum queue
         # positions come last — they can only take capacity slots real
         # tokens left unused — and their output rows are sliced off below.
+        mesh_axes = set(self.mesh.axis_names) if self.mesh is not None else set()
+        tok_axes = tuple(a for a in self.token_axes if a in mesh_axes)
+        tok_shards = 1
+        for a in tok_axes:
+            tok_shards *= self.mesh.shape[a]
         s = min(self.group_size, n)
         g = -(-n // s)
+        if tok_shards > 1:
+            # GShard layout: the group dim is sharded over the token axes,
+            # so it must be a multiple of their shard count
+            g = -(-g // tok_shards) * tok_shards
+            s = -(-n // g)
         n_pad = g * s
-        cap = max(1, int(self.capacity_factor * s / e))
+        cap = max(1, int(self.capacity_factor * self.router_topk * s / e))
         xf = x.reshape(n, d)
         if n_pad != n:
             xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
         xg = xf.reshape(g, s, d)
+
+        def on_tok(arr):
+            """Group dim sharded over the token axes (no-op without mesh)."""
+            if tok_axes:
+                from jax.sharding import NamedSharding
+
+                spec = P(tok_axes, *([None] * (arr.ndim - 1)))
+                return jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(self.mesh, spec)
+                )
+            return arr
+
+        xg = on_tok(xg)
+
+        # validity mask for the zero-padding rows appended above; padding
+        # is excluded from routing entirely (it must never consume a
+        # capacity slot or skew count1/aux/drop statistics)
+        if n_pad != n:
+            valid = (jnp.arange(n_pad) < n).astype(jnp.float32).reshape(g, s, 1)
+        else:
+            valid = jnp.ones((g, s, 1), jnp.float32)
 
         logits = nn.Dense(e, name="router", dtype=jnp.float32)(
             xg.astype(jnp.float32)
@@ -82,7 +127,7 @@ class SwitchFFN(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)  # (G, S, E)
         gate = jnp.max(probs, axis=-1)  # (G, S)
         choice = jnp.argmax(probs, axis=-1)  # (G, S)
-        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (G, S, E)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32) * valid  # (G, S, E)
 
         # queue position of each token within its chosen expert's per-group
         # queue; -1 where the token did not choose that expert (one_hot of
@@ -93,7 +138,33 @@ class SwitchFFN(nn.Module):
             jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
             * within_cap[..., None]
         )  # (G, S, E, C)
-        combine = dispatch * gate[..., None, None]
+        if self.router_topk == 2:
+            # GShard top-2: second choice = argmax with the first masked
+            # out; its queue positions start AFTER every first choice in
+            # the group; gates renormalized over the two picks
+            probs2 = probs * (1.0 - onehot)
+            gate2 = jnp.max(probs2, axis=-1)
+            onehot2 = (
+                jax.nn.one_hot(jnp.argmax(probs2, axis=-1), e, dtype=jnp.float32)
+                * valid
+            )
+            count1 = jnp.sum(onehot, axis=1, keepdims=True)  # (G, 1, E)
+            pos2 = (jnp.cumsum(onehot2, axis=1) + count1) * onehot2 - 1.0
+            within2 = (pos2 >= 0.0) & (pos2 < cap)
+            d2 = (
+                jax.nn.one_hot(pos2.astype(jnp.int32), cap, dtype=jnp.float32)
+                * within2[..., None]
+            )
+            denom = jnp.maximum(gate + gate2, 1e-9)
+            combine = (
+                dispatch * (gate / denom)[..., None, None]
+                + d2 * (gate2 / denom)[..., None, None]
+            )
+            dispatch = dispatch + d2
+        elif self.router_topk == 1:
+            combine = dispatch * gate[..., None, None]
+        else:
+            raise ValueError(f"router_topk must be 1 or 2, got {self.router_topk}")
 
         w_up = self.param(
             "expert_up", nn.initializers.lecun_normal(), (e, d, self.ff_dim)
@@ -104,15 +175,47 @@ class SwitchFFN(nn.Module):
         )
         b_down = self.param("expert_down_bias", nn.initializers.zeros, (e, d))
 
-        xin = jnp.einsum("gsec,gsd->gecd", dispatch, xg.astype(jnp.float32))
-        h = jnp.einsum(
-            "gecd,edf->gecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
-        ).astype(jnp.float32) + b_up[None, :, None, :]
+        def on_ep(arr):
+            """Expert dim (axis 1) pinned onto the ep mesh axis; the group
+            dim keeps any token axes that are NOT the ep axis (dp rows).
+            The transition from on_tok to on_ep layout IS the token
+            exchange — GSPMD lowers it to an all-to-all over ep."""
+            if self.mesh is not None and self.ep_axis in mesh_axes:
+                from jax.sharding import NamedSharding
+
+                g_axes = tuple(a for a in tok_axes if a != self.ep_axis)
+                spec = P(
+                    g_axes if g_axes else None,
+                    self.ep_axis,
+                    *([None] * (arr.ndim - 2)),
+                )
+                return jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(self.mesh, spec)
+                )
+            return arr
+
+        # dispatch locally on each group shard FIRST (on_tok), then
+        # reshard to the expert layout (on_ep): the double constraint
+        # keeps GSPMD from fusing the layout change into the einsum
+        # (which would all-gather the inputs) — the reshard itself is
+        # the token exchange, lowered to an all-to-all over ep
+        xin = on_ep(
+            on_tok(jnp.einsum("gsec,gsd->gecd", dispatch, xg.astype(jnp.float32)))
+        )
+        h = on_ep(
+            jnp.einsum(
+                "gecd,edf->gecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
+            ).astype(jnp.float32)
+            + b_up[None, :, None, :]
+        )
         h = jax.nn.gelu(h)
-        out = jnp.einsum(
-            "gecf,efd->gecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
-        ).astype(jnp.float32) + b_down[None, :, None, :]
-        y = jnp.einsum("gsec,gecd->gsd", combine, out)
+        out = on_ep(
+            jnp.einsum(
+                "gecf,efd->gecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
+            ).astype(jnp.float32)
+            + b_down[None, :, None, :]
+        )
+        y = on_tok(jnp.einsum("gsec,gecd->gsd", combine, out))
 
         # Switch load-balance loss: E * sum_e f_e * p_e, minimized (=1) at
         # uniform routing; scaled in by the training loss, not here.
@@ -122,12 +225,40 @@ class SwitchFFN(nn.Module):
             frac_tokens = (onehot * valid).sum(axis=(0, 1)) / n
             frac_probs = (probs * valid).sum(axis=(0, 1)) / n
         else:
+            valid = jnp.ones((g, s, 1), jnp.float32)
             frac_tokens = onehot.mean(axis=(0, 1))
             frac_probs = probs.mean(axis=(0, 1))
         aux = e * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "aux_loss", aux)
 
+        # router z-loss (ST-MoE): keeps router logits from drifting large,
+        # which otherwise saturates the softmax and destabilizes bf16
+        z = jax.scipy.special.logsumexp(logits, axis=-1)  # (G, S)
+        z_loss = jnp.sum(z**2 * valid[..., 0]) / n
+        self.sow("intermediates", "router_z_loss", z_loss)
+
+        # dropped-token fraction: a METRIC, not a loss term (seq_loss
+        # skips it) — capacity overflow is silent otherwise. Each real
+        # token owes router_topk assignments; count how many landed.
+        assigned = jnp.sum(dispatch, axis=(2, 3)) * valid[..., 0]  # (G, S)
+        drop_frac = 1.0 - jnp.sum(assigned) / (n * self.router_topk)
+        self.sow("intermediates", "drop_fraction", drop_frac)
+
         return y.reshape(n_pad, d)[:n].reshape(b, t, d).astype(x.dtype)
+
+
+def moe_metrics(sown: Any) -> dict[str, float]:
+    """Pull routing health metrics out of a ``mutable="intermediates"``
+    apply: mean drop_fraction / aux_loss / router_z_loss across layers."""
+    from jax.tree_util import tree_flatten_with_path
+
+    sums: dict[str, list] = {}
+    for path, leaf in tree_flatten_with_path(sown)[0]:
+        names = path_key_names(path)
+        for key in ("drop_fraction", "aux_loss", "router_z_loss"):
+            if key in names:
+                sums.setdefault(key, []).append(leaf)
+    return {k: float(sum(v) / len(v)) for k, v in sums.items()}
 
 
 def _is_expert_path(path: tuple) -> bool:

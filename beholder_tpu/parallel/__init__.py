@@ -12,7 +12,10 @@ from .mesh import (
     batch_sharding,
     make_mesh,
     param_shardings,
+    place_seq_state,
     replicated,
+    seq_state_shardings,
+    sharded_seq_train_step,
     sharded_train_step,
 )
 from .pipeline import (
@@ -33,6 +36,9 @@ __all__ = [
     "batch_sharding",
     "param_shardings",
     "replicated",
+    "seq_state_shardings",
+    "place_seq_state",
+    "sharded_seq_train_step",
     "sharded_train_step",
     "initialize",
     "make_hybrid_mesh",

@@ -79,6 +79,58 @@ def place_state(state: Any, mesh: Mesh) -> Any:
     return jax.device_put(state, state_shardings(state, mesh))
 
 
+# -- megatron tensor parallelism for the transformer ------------------------
+#
+# Column-parallel (output dim sharded over tp): q/k/v projections and the
+# FFN up-projection — each tp shard holds whole heads / a slice of the
+# hidden. Row-parallel (contracting dim sharded): the attention out-proj
+# and FFN down-projection — GSPMD inserts one psum per row layer, exactly
+# megatron's two all-reduces per block. Embedding, head, LayerNorms, and
+# biases of row layers stay replicated.
+
+_COLUMN = ("q_proj", "k_proj", "v_proj", "up")
+_ROW = ("proj", "down")
+
+
+def _seq_spec_for(path: tuple, leaf: Any) -> P:
+    names = path_key_names(path)
+    ndim = getattr(leaf, "ndim", 0)
+    if any(n in names for n in _COLUMN):
+        if ndim == 2:
+            return P(None, "tp")  # (d_in, d_out/tp)
+        if ndim == 1:
+            return P("tp")  # bias lives with the sharded output dim
+    if any(n in names for n in _ROW) and ndim == 2:
+        return P("tp", None)  # (d_in/tp, d_out); psum after
+    return P()
+
+
+def seq_state_shardings(state: Any, mesh: Mesh) -> Any:
+    """Sharding pytree for a sequence-model TrainState (params + adam
+    moments + step), megatron column/row TP over the ``tp`` axis."""
+    return shardings_from_specs(path_specs(state, _seq_spec_for), mesh)
+
+
+def place_seq_state(state: Any, mesh: Mesh) -> Any:
+    return jax.device_put(state, seq_state_shardings(state, mesh))
+
+
+def sharded_seq_train_step(model, tx, mesh: Mesh, state_template: Any):
+    """Jit the sequence-model train step over a ("dp", "tp") mesh:
+    batch dp-sharded, every Block's q/k/v/up column-parallel and
+    proj/down row-parallel. Returns fn(state, feats, targets)."""
+    from beholder_tpu.models.sequence import seq_train_step
+
+    shardings = seq_state_shardings(state_template, mesh)
+    data = NamedSharding(mesh, P("dp", *([None] * 2)))
+    tgt = NamedSharding(mesh, P("dp", None))
+    return jax.jit(
+        lambda state, f, t: seq_train_step(model, tx, state, f, t),
+        in_shardings=(shardings, data, tgt),
+        out_shardings=(shardings, replicated(mesh)),
+    )
+
+
 def sharded_train_step(tx, mesh: Mesh, state_template: Any):
     """Jit the pure train step with explicit in/out shardings on ``mesh``.
 

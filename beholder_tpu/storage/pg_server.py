@@ -50,6 +50,13 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):  # noqa: C901 - one protocol loop, clearer flat
         server: PgTestServer = self.server.owner  # type: ignore[attr-defined]
         sock = self.request
+        server.active.add(sock)
+        try:
+            self._serve(server, sock)
+        finally:
+            server.active.discard(sock)
+
+    def _serve(self, server: "PgTestServer", sock):
         buf = b""
 
         def need(n):
@@ -205,12 +212,20 @@ class PgTestServer:
         self._scram_salt = os.urandom(16)
         self.rows: dict[str, dict] = {}
         self.queries: list[tuple[str, tuple]] = []  # for assertions
+        self.active: set = set()  # live client sockets, killed on stop()
         self._server: socketserver.ThreadingTCPServer | None = None
         self.port: int | None = None
 
     # -- lifecycle ----------------------------------------------------------
-    def start(self) -> int:
-        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Handler)
+    def start(self, port: int = 0) -> int:
+        """Listen on ``port`` (0 = ephemeral). Restarting on the same port
+        after :meth:`stop` keeps ``rows`` — the crash-recovery tests kill
+        and resurrect the server while clients reconnect."""
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # same-port restart right after stop
+
+        srv = _Srv(("127.0.0.1", port), _Handler)
         srv.daemon_threads = True
         srv.owner = self  # type: ignore[attr-defined]
         self._server = srv
@@ -219,10 +234,21 @@ class PgTestServer:
         return self.port
 
     def stop(self) -> None:
+        """Stop listening AND sever every live connection (a real crash
+        doesn't let handler threads keep answering)."""
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        for sock in list(self.active):
+            try:
+                sock.shutdown(2)  # SHUT_RDWR: wake any blocked recv
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def url(self, user: str = "beholder") -> str:
         auth = f"{user}:{self.password}@" if self.password else f"{user}@"

@@ -98,6 +98,7 @@ class PgConnection:
             (self.url.host, self.url.port), timeout=self._timeout
         )
         sock.settimeout(self._timeout)
+        self._buf = b""  # a poisoned/closed connection may be re-connected
         self._sock = sock
         params = (
             struct.pack(">I", 196608)  # protocol 3.0
@@ -170,6 +171,12 @@ class PgConnection:
             except (OSError, TimeoutError) as err:
                 self._poison()
                 raise ProtocolError(f"connection lost mid-query: {err}") from err
+            except ProtocolError:
+                # server EOF mid-response surfaces as ProtocolError from
+                # _fill(); a partial response may sit in the buffer, so the
+                # stream can no longer be trusted — poison here too
+                self._poison()
+                raise
 
     def execute(self, sql: str) -> str:
         """Simple-query protocol for DDL; returns the command tag."""
@@ -182,6 +189,9 @@ class PgConnection:
             except (OSError, TimeoutError) as err:
                 self._poison()
                 raise ProtocolError(f"connection lost mid-query: {err}") from err
+            except ProtocolError:
+                self._poison()
+                raise
 
     def _poison(self) -> None:
         """Invalidate the connection after an I/O fault; the response

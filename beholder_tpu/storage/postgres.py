@@ -5,14 +5,23 @@ The reference's production storage (index.js:19,42 via triton-core's
 reconstructed from the fields the reference reads/writes
 (index.js:64,68,74-118,131-148: id, name, creator, creatorId,
 metadataId, status).
+
+Elastic recovery: when the wire client poisons its connection (server
+restart, network fault — any :class:`ProtocolError`), the storage
+reconnects with bounded exponential backoff and re-runs the statement,
+mirroring the AMQP client's reconnect design (``mq/amqp.py``). Retrying
+is safe because every statement here is idempotent: the upsert, the
+absolute status UPDATE, and the SELECT all converge on re-execution.
 """
 
 from __future__ import annotations
 
+import time
+
 from beholder_tpu import proto
 
 from .base import MediaNotFound, Storage
-from .pg_wire import PgConnection
+from .pg_wire import PgConnection, ProtocolError
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS media (
@@ -29,12 +38,44 @@ CREATE TABLE IF NOT EXISTS media (
 class PostgresStorage(Storage):
     """``Storage`` over a real Postgres (or wire-compatible) server."""
 
-    def __init__(self, url: str, connect_timeout: float = 10.0):
+    def __init__(
+        self,
+        url: str,
+        connect_timeout: float = 10.0,
+        reconnect_attempts: int = 3,
+        reconnect_delay: float = 0.05,
+    ):
         self._conn = PgConnection(url, connect_timeout=connect_timeout)
+        self._attempts = reconnect_attempts
+        self._delay = reconnect_delay
+        self._connect()
+
+    def _connect(self) -> None:
         self._conn.connect()
-        self._conn.execute(_SCHEMA)
+        self._conn.execute(_SCHEMA)  # idempotent; safe on every reconnect
+
+    def _run(self, fn):
+        """Run a statement; on a poisoned connection, reconnect with
+        bounded exponential backoff and re-run (statements here are all
+        idempotent — see module docstring)."""
+        try:
+            return fn()
+        except ProtocolError as err:
+            last: Exception = err
+        for attempt in range(self._attempts):
+            time.sleep(self._delay * (2**attempt))
+            try:
+                self._conn.close()
+                self._connect()
+                return fn()
+            except (ProtocolError, OSError) as err:
+                last = err
+        raise last
 
     def add_media(self, media: proto.Media) -> None:
+        self._run(lambda: self._query_add(media))
+
+    def _query_add(self, media: proto.Media) -> None:
         self._conn.query(
             "INSERT INTO media (id, name, creator, creator_id, metadata_id, status) "
             "VALUES ($1, $2, $3, $4, $5, $6) "
@@ -51,17 +92,22 @@ class PostgresStorage(Storage):
         )
 
     def update_status(self, media_id: str, status: int) -> None:
-        _, _, tag = self._conn.query(
-            "UPDATE media SET status = $1 WHERE id = $2", (int(status), media_id)
+        _, _, tag = self._run(
+            lambda: self._conn.query(
+                "UPDATE media SET status = $1 WHERE id = $2",
+                (int(status), media_id),
+            )
         )
         if tag.endswith(" 0"):  # "UPDATE 0" — no row matched
             raise MediaNotFound(media_id)
 
     def get_by_id(self, media_id: str) -> proto.Media:
-        _, rows, _ = self._conn.query(
-            "SELECT id, name, creator, creator_id, metadata_id, status "
-            "FROM media WHERE id = $1",
-            (media_id,),
+        _, rows, _ = self._run(
+            lambda: self._conn.query(
+                "SELECT id, name, creator, creator_id, metadata_id, status "
+                "FROM media WHERE id = $1",
+                (media_id,),
+            )
         )
         if not rows:
             raise MediaNotFound(media_id)

@@ -71,3 +71,111 @@ def test_ring_single_device_degenerates_to_flash():
     want = full_attention(q, k, v, causal=True)
     got = ring_attention(q, k, v, mesh, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_gradients_match_full(sp_mesh, causal):
+    """The custom VJP (second ring pass + traveling dk/dv partials) must
+    reproduce plain autodiff through full attention."""
+    q, k, v = _qkv(6)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    want = jax.grad(
+        loss(lambda q, k, v: full_attention(q, k, v, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    got = jax.grad(
+        loss(lambda q, k, v: ring_attention(q, k, v, sp_mesh, causal=causal)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-3, atol=2e-4
+        )
+
+
+def test_ring_backward_saves_no_probability_blocks(sp_mesh):
+    """Training residuals are O(T/P * d): the jaxpr of the grad must hold
+    no global (T, T) tensor anywhere, and no (T/P, T/P) block may cross
+    the forward/backward boundary (flash-style recompute instead).
+
+    Styled after test_flash_never_materializes_scores; the boundary check
+    inspects the custom-vjp forward's outputs = exactly its residuals.
+    """
+    t = 256
+    q, k, v = _qkv(7, t=t)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, sp_mesh, causal=True) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                assert var.aval.shape[-2:] != (t, t), (
+                    f"global (T,T) tensor from {eqn.primitive}"
+                )
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+
+    # residual check: what the forward saves for the backward
+    out, f_vjp = jax.vjp(loss, q, k, v)
+    block = t // 8
+    for leaf in jax.tree.leaves(f_vjp):
+        if hasattr(leaf, "shape"):
+            assert leaf.shape[-2:] != (block, block), (
+                f"(T/P, T/P) probability block saved as residual: {leaf.shape}"
+            )
+            assert leaf.shape[-2:] != (t, t)
+
+
+def test_ring_custom_vjp_uses_less_memory_than_autodiff(sp_mesh):
+    """The custom VJP must beat plain autodiff-through-the-forward (the
+    round-1 design, which saved every rotation step's probability block
+    as a residual) on compiled peak temp memory."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from beholder_tpu.ops import attention as A
+
+    spec = P(None, "sp", None)
+
+    def autodiff_ring(q, k, v):
+        # the old path: shard_map the forward, let JAX differentiate it
+        block = q.shape[-2] // 8
+        return jax.shard_map(
+            functools.partial(
+                A._ring_local_fwd, axis="sp", p_size=8, block=block,
+                causal=True, want_lse=False,
+            ),
+            mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+
+    def temp_bytes(fn, t):
+        q, k, v = _qkv(8, batch=1, t=t, d=16)
+
+        def loss(q, k, v):
+            return jnp.sum(fn(q, k, v) ** 2)
+
+        compiled = (
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, k, v).compile()
+        )
+        stats = compiled.memory_analysis()
+        if stats is None:  # backend without memory stats: skip
+            pytest.skip("backend reports no memory analysis")
+        return stats.temp_size_in_bytes
+
+    t = 2048
+    custom = temp_bytes(
+        lambda q, k, v: ring_attention(q, k, v, sp_mesh, causal=True), t
+    )
+    autodiff = temp_bytes(autodiff_ring, t)
+    assert custom < autodiff, (custom, autodiff)

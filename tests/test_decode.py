@@ -80,6 +80,22 @@ def test_decode_step_is_shape_stable(setup):
     assert pred.shape == (3,)
 
 
+def test_decode_works_on_remat_model(setup):
+    """remat=True must not break the cache path: the model swaps in the
+    plain Block for decode/prefill (jax.checkpoint would trace the cache
+    pytree and the return_kv bool), and predictions still match the
+    non-remat model exactly (same params, same math)."""
+    _, params, prog, stats = setup
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2, remat=True)
+    feats, _ = stream_features(prog, stats)
+    _, cache = prefill(model, params, feats[:, :10], max_len=feats.shape[1])
+    pred, cache = decode_step(model, params, cache, feats[:, 10])
+    plain = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    _, cache_p = prefill(plain, params, feats[:, :10], max_len=feats.shape[1])
+    pred_p, _ = decode_step(plain, params, cache_p, feats[:, 10])
+    np.testing.assert_array_equal(np.asarray(pred), np.asarray(pred_p))
+
+
 def test_forecast_deltas_shape_and_finiteness(setup):
     model, params, prog, stats = setup
     deltas = forecast_deltas(model, params, prog, stats, horizon=12)

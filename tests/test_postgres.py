@@ -126,6 +126,51 @@ def test_server_error_surfaces_with_sqlstate(server):
     conn.close()
 
 
+def test_storage_recovers_from_server_restart(trust_server):
+    """Kill the server under an open connection; the storage reconnects
+    and serves the next statements (elastic recovery, mirroring the AMQP
+    broker kill/restart tests in test_health.py)."""
+    db = PostgresStorage(trust_server.url())
+    db.add_media(media(status=1))
+
+    port = trust_server.port
+    trust_server.stop()  # severs the established connection too
+    trust_server.start(port=port)  # same port, rows preserved
+
+    db.update_status("m1", 4)  # poisoned socket -> reconnect -> re-run
+    assert db.get_by_id("m1").status == 4
+    db.close()
+
+
+def test_storage_raises_after_retries_when_server_stays_down(trust_server):
+    db = PostgresStorage(
+        trust_server.url(), reconnect_attempts=2, reconnect_delay=0.01
+    )
+    db.add_media(media())
+    trust_server.stop()
+    with pytest.raises(Exception):  # noqa: B017 - ProtocolError or OSError
+        db.update_status("m1", 2)
+    # and recovers once the server is back
+    trust_server.start(port=trust_server.port)
+    db.update_status("m1", 5)
+    assert db.get_by_id("m1").status == 5
+    db.close()
+
+
+def test_wire_client_poisons_on_server_eof(trust_server):
+    """A mid-session EOF must poison the connection (ADVICE: ProtocolError
+    from the recv path previously escaped the poison guard)."""
+    from beholder_tpu.storage.pg_wire import ProtocolError
+
+    conn = PgConnection(trust_server.url())
+    conn.connect()
+    trust_server.stop()
+    with pytest.raises(ProtocolError):
+        conn.query("SELECT id FROM media WHERE id = $1", ("x",))
+    assert conn.closed  # poisoned, not left half-open
+    trust_server.start(port=trust_server.port)
+
+
 def test_postgres_storage_gate_builds_real_backend(trust_server):
     db = postgres_storage(trust_server.url())
     assert isinstance(db, PostgresStorage)
