@@ -11,12 +11,14 @@ RUN apt-get update \
     && apt-get install -y --no-install-recommends g++ make \
     && rm -rf /var/lib/apt/lists/*
 
-COPY pyproject.toml README.md ./
+COPY pyproject.toml README.md constraints.txt ./
 COPY beholder_tpu ./beholder_tpu
 COPY native ./native
 COPY Makefile ./
 
-RUN pip install --no-cache-dir . && make native
+# -c constraints.txt pins the full dependency closure (the reference's
+# yarn.lock role) so image builds are reproducible
+RUN pip install --no-cache-dir -c constraints.txt . && make native
 
 # the package is imported from site-packages, so point it at the built
 # scanner explicitly (its relative search paths don't cover /app)
