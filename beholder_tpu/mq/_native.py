@@ -71,15 +71,23 @@ class NativeScanner:
         self._offsets = (ctypes.c_int64 * _MAX_FRAMES)()
         self._sizes = (ctypes.c_int64 * _MAX_FRAMES)()
         self._consumed = ctypes.c_int64(0)
+        # pre-cast memoryviews for bulk tolist() (ctypes' native "<i" format
+        # doesn't support tolist; a byte-cast round trip does)
+        self._types_mv = memoryview(self._types).cast("B").cast("i")
+        self._channels_mv = memoryview(self._channels).cast("B").cast("i")
+        self._offsets_mv = memoryview(self._offsets).cast("B").cast("q")
+        self._sizes_mv = memoryview(self._sizes).cast("B").cast("q")
 
-    def scan(self, buf: bytearray) -> tuple[list[tuple[int, int, bytes]], int]:
+    def scan(self, buf: bytearray, factory) -> tuple[list, int]:
         """Scan ``buf`` for complete frames without copying it.
 
         Returns (frames, consumed); the caller trims ``buf[:consumed]``
         afterwards (all buffer exports are released before returning).
+        ``factory(type, channel, payload)`` builds each result (the codec
+        passes its ``Frame`` class so no intermediate tuples are built).
         Raises ``ValueError`` on a bad frame-end octet.
         """
-        frames: list[tuple[int, int, bytes]] = []
+        frames: list = []
         total = len(buf)
         if total < 8:
             return frames, 0
@@ -107,15 +115,18 @@ class NativeScanner:
                         "bad frame end at buffer offset "
                         f"{consumed_total + self._consumed.value}"
                     )
-                for i in range(n):
-                    start = consumed_total + self._offsets[i]
-                    frames.append(
-                        (
-                            self._types[i],
-                            self._channels[i],
-                            bytes(mv[start : start + self._sizes[i]]),
-                        )
-                    )
+                # bulk-convert the scratch arrays via the buffer protocol:
+                # per-element ctypes __getitem__ costs ~100ns each and made
+                # the native path slower than the pure-Python walk; one
+                # memoryview.tolist() per array is a single C-speed pass
+                types = self._types_mv[:n].tolist()
+                channels = self._channels_mv[:n].tolist()
+                offsets = self._offsets_mv[:n].tolist()
+                sizes = self._sizes_mv[:n].tolist()
+                append = frames.append
+                for t, c, off, size in zip(types, channels, offsets, sizes):
+                    start = consumed_total + off
+                    append(factory(t, c, bytes(mv[start : start + size])))
                 consumed_total += self._consumed.value
                 if n < _MAX_FRAMES:
                     return frames, consumed_total

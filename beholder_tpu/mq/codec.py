@@ -12,8 +12,7 @@ from-scratch equivalent of that transport layer.
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass
-from typing import Any
+from typing import Any, NamedTuple
 
 PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
 FRAME_END = 0xCE
@@ -242,8 +241,10 @@ class Reader:
 # --------------------------------------------------------------------------
 
 
-@dataclass
-class Frame:
+class Frame(NamedTuple):
+    # NamedTuple, not dataclass: Frame construction is the per-frame unit of
+    # work in the parse hot loop and tuple.__new__ is ~2x cheaper than a
+    # dataclass __init__
     type: int
     channel: int
     payload: bytes
@@ -354,9 +355,15 @@ class FrameParser:
         self._buf = bytearray()
         self._scanner = None
         if use_native is None:
+            import os
+
             from . import _native
 
-            if _native.available():
+            # BEHOLDER_NATIVE_CODEC=0 forces the pure-Python walk even when
+            # the scanner is built (used by bench.py's native on/off figure)
+            if _native.available() and os.environ.get(
+                "BEHOLDER_NATIVE_CODEC"
+            ) != "0":
                 self._scanner = _native.NativeScanner()
         elif use_native:
             from . import _native
@@ -367,11 +374,11 @@ class FrameParser:
         self._buf.extend(data)
         if self._scanner is not None:
             try:
-                scanned, consumed = self._scanner.scan(self._buf)
+                frames, consumed = self._scanner.scan(self._buf, Frame)
             except ValueError as err:
                 raise ProtocolError(str(err)) from None
             del self._buf[:consumed]
-            return [Frame(t, c, p) for t, c, p in scanned]
+            return frames
         return self._feed_python()
 
     def _feed_python(self) -> list[Frame]:

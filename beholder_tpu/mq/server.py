@@ -251,10 +251,20 @@ class _Conn(asyncio.Protocol):
             .shortstr(queue)  # routing key
             .getvalue()
         )
-        self._send_method(1, codec.BASIC_DELIVER, args)
-        self._send(codec.header_frame(1, codec.CLASS_BASIC, len(body), headers=headers))
+        # one write per delivery: method + header + body frames coalesced
+        # (3+ separate transport.write calls each cost a send syscall on an
+        # idle connection; this path is the broker's hot loop)
+        out = bytearray(codec.method_frame(1, codec.BASIC_DELIVER, args).serialize())
+        out += codec.header_frame(
+            1, codec.CLASS_BASIC, len(body), headers=headers
+        ).serialize()
         for bf in codec.body_frames(1, body, codec_frame_max()):
-            self._send(bf)
+            out += bf.serialize()
+        if self.transport and not self.transport.is_closing():
+            # write the bytearray directly: the transport copies into its own
+            # buffer, and a bytes(out) round trip would re-copy the whole
+            # body on this hot loop
+            self.transport.write(out)
 
 
 def codec_frame_max() -> int:

@@ -33,6 +33,12 @@ class SqliteStorage(Storage):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock, self._conn:
+            # WAL + NORMAL: one fsync per checkpoint instead of per commit.
+            # Status updates are idempotent telemetry (the producer re-sends
+            # state transitions), so power-loss durability of the last few
+            # commits is not worth a ~50x throughput cliff on the hot path.
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
             self._conn.execute(_SCHEMA)
 
     def add_media(self, media: proto.Media) -> None:
