@@ -18,10 +18,14 @@ Design (see /opt/skills/guides/pallas_guide.md):
   accumulation (``preferred_element_type``): bf16 inputs use the MXU's
   double-rate bf16 path, exactly matching ``full_attention``'s dtype mix
   (bf16 score matmul, f32 softmax, bf16 probability @ v).
-- Causal masking skips work at block granularity: fully-masked kv blocks
-  clamp their BlockSpec index to the diagonal (same index as the previous
-  step -> Pallas skips the DMA entirely) and ``pl.when`` skips the
-  compute, so the causal forward does ~half the work of the full grid.
+- Causal masking skips work at GRID granularity in the forward: the grid
+  is a packed triangular (bh, n_live) enumeration of only the live
+  (qi >= kj) block pairs, driven by scalar-prefetched (qi, kj) lookup
+  tables (``PrefetchScalarGridSpec``) — fully masked pairs never iterate,
+  so the causal forward does ~half the work of the full grid and the
+  advantage grows with T (see ROOFLINE.md). The backward kernels keep the
+  rectangular grid with clamped BlockSpec indices (no DMA for dead steps)
+  plus a ``pl.when`` liveness guard.
 - The kernel emits the per-row logsumexp, making the backward
   recomputation exact.
 - Backward: TWO Pallas kernels with the same streaming discipline —
@@ -65,6 +69,22 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def _tri_tables(n_blk):
+    """Host-side (qi, kj) lookup tables for the packed causal grid.
+
+    Enumerates (0,0),(1,0),(1,1),(2,0),... so the causal grid contains ONLY
+    live blocks — a rectangular grid would spend ~40% of its steps on fully
+    masked (qi < kj) pairs that still pay grid/DMA-sync overhead. The tables
+    ride scalar prefetch (SMEM): index maps do one table load per step
+    instead of recomputing a triangular decode on the scalar core.
+    """
+    import numpy as np
+
+    qi = np.repeat(np.arange(n_blk), np.arange(1, n_blk + 1))
+    kj = np.concatenate([np.arange(i + 1) for i in range(n_blk)])
+    return jnp.asarray(qi, jnp.int32), jnp.asarray(kj, jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -79,7 +99,7 @@ _SUB = 1024
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, t_real, t_pad, causal, scale, block,
+    qi_kj, *, t_real, t_pad, causal, scale, block,
 ):
     """One (block, d) q tile x one streamed (block, d) kv tile.
 
@@ -89,10 +109,19 @@ def _fwd_kernel(
     Masking is only computed where it can bite: the causal diagonal
     block and (when T was padded) the last kv block — interior blocks
     skip the iota/compare/select entirely.
+
+    Causal runs on a PACKED triangular grid (bh, n_live): (qi, kj) come
+    from scalar-prefetched lookup tables so fully-masked pairs never
+    iterate. Non-causal keeps the rectangular (bh, nq, nkv) grid.
     """
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    n_kv = pl.num_programs(2)
+    n_blk = t_pad // block
+    if causal:
+        qi, kj = qi_kj            # read from the scalar-prefetch tables
+        last_kv = qi              # the diagonal block ends row qi
+    else:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        last_kv = pl.num_programs(2) - 1
 
     @pl.when(kj == 0)
     def _init():
@@ -149,19 +178,19 @@ def _fwd_kernel(
             m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
             s = s_next
 
-    # causal: kv blocks strictly past the q tile's diagonal are fully
-    # masked — their BlockSpec index was clamped (no DMA), skip compute
-    live = (qi >= kj) if causal else True
+    # the packed causal grid contains only live (qi >= kj) pairs, so no
+    # liveness guard is needed; masking applies on the diagonal block and
+    # (when T was padded) the last kv block
     needs_mask = (qi == kj) if causal else False
     if t_pad != t_real:
-        needs_mask = needs_mask | (kj == n_kv - 1)
+        needs_mask = needs_mask | (kj == n_blk - 1)
     if needs_mask is False:
-        pl.when(live)(lambda: _chunks(False))
+        _chunks(False)
     else:
-        pl.when(live & needs_mask)(lambda: _chunks(True))
-        pl.when(live & jnp.logical_not(needs_mask))(lambda: _chunks(False))
+        pl.when(needs_mask)(lambda: _chunks(True))
+        pl.when(jnp.logical_not(needs_mask))(lambda: _chunks(False))
 
-    @pl.when(kj == n_kv - 1)
+    @pl.when(kj == last_kv)
     def _finalize():
         l = l_ref[:, :1]
         m = m_ref[:, :1]
@@ -183,37 +212,79 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
     block = _pick_block(t_pad)
     n_blk = t_pad // block
 
+    scratch = [
+        pltpu.VMEM((block, _LANES), jnp.float32),  # m
+        pltpu.VMEM((block, _LANES), jnp.float32),  # l
+        pltpu.VMEM((block, d_pad), jnp.float32),   # acc
+    ]
+
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, t_pad, _LANES), jnp.float32),
+    ]
+
     if causal:
-        # clamp fully-masked kv blocks to the diagonal: same index as the
-        # previous grid step -> Pallas skips the DMA
-        kv_map = lambda b, i, j: (b, jnp.minimum(j, i), 0)
-    else:
-        kv_map = lambda b, i, j: (b, j, 0)
+        # packed triangular grid: one step per LIVE (qi, kj) block pair,
+        # driven by scalar-prefetched lookup tables (index maps do one SMEM
+        # load per step; a computed decode would run on the scalar core and
+        # stall DMA issue)
+        qi_tab, kj_tab = _tri_tables(n_blk)
+        q_map = lambda b, l, qt, kt: (b, qt[l], 0)
+        kv_map = lambda b, l, qt, kt: (b, kt[l], 0)
+
+        def kernel(qt_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_ref, l_ref, acc_ref):
+            lin = pl.program_id(1)
+            _fwd_kernel(
+                q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                (qt_ref[lin], kt_ref[lin]),
+                t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
+                block=block,
+            )
+
+        o, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, n_blk * (n_blk + 1) // 2),
+                in_specs=[
+                    pl.BlockSpec((1, block, d_pad), q_map),
+                    pl.BlockSpec((1, block, d_pad), kv_map),
+                    pl.BlockSpec((1, block, d_pad), kv_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block, d_pad), q_map),
+                    pl.BlockSpec((1, block, _LANES), q_map),
+                ],
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(qi_tab, kj_tab, q, k, v)
+        return o, lse[:, :, 0]
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
+        _fwd_kernel(
+            q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+            None,
+            t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
+            block=block,
+        )
 
     o, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel, t_real=t_real, t_pad=t_pad, causal=causal,
-            scale=scale, block=block,
-        ),
+        kernel,
         grid=(bh, n_blk, n_blk),
         in_specs=[
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block, d_pad), kv_map),
-            pl.BlockSpec((1, block, d_pad), kv_map),
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block, _LANES), lambda b, i, j: (b, i, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, t_pad, _LANES), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block, _LANES), jnp.float32),  # m
-            pltpu.VMEM((block, _LANES), jnp.float32),  # l
-            pltpu.VMEM((block, d_pad), jnp.float32),   # acc
-        ],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
     return o, lse[:, :, 0]

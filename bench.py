@@ -334,7 +334,9 @@ def bench_aggregation() -> dict:
 
 def bench_flash_attention() -> dict:
     """Secondary: the Pallas flash-attention kernel vs XLA full attention
-    on the accelerator (causal, bf16, B=4 H=8 T=4096 d=128)."""
+    on the accelerator (bf16, d=128). Reports forward AND backward
+    TFLOP/s plus MFU against the v5e spec peak and against the chip's
+    MEASURED dense-matmul ceiling (see ROOFLINE.md for the analysis)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -342,31 +344,93 @@ def bench_flash_attention() -> dict:
     from beholder_tpu.ops.attention import full_attention
     from beholder_tpu.ops.flash_attention import flash_attention
 
+    # v5e bf16 spec peak (TPU v5e datasheet); MFU is reported against this
+    chip_peak = 197e12
+
+    def readback(x):
+        return float(np.asarray(x[(0,) * x.ndim]))
+
+    def timeit(f, *args, reps=20):
+        # best of two measurement rounds: the shared-chip environment shows
+        # 20-30% run-to-run swings, and min is the interference-robust
+        # estimator
+        out = f(*args)
+        for leaf in jax.tree.leaves(out):
+            readback(leaf)
+        best = float("inf")
+        for _ in range(2):
+            start = time.perf_counter()
+            for _ in range(reps):
+                out = f(*args)
+            for leaf in jax.tree.leaves(out):
+                readback(leaf)
+            best = min(best, (time.perf_counter() - start) / reps)
+        return best
+
+    # the chip's PRACTICAL matmul ceiling in this environment: one large
+    # dense bf16 matmul through the same harness
+    a = jax.random.normal(jax.random.PRNGKey(0), (8192, 8192), jnp.bfloat16)
+    bm = jax.random.normal(jax.random.PRNGKey(1), (8192, 8192), jnp.bfloat16)
+    tm = timeit(jax.jit(lambda a, b: a @ b), a, bm, reps=10)
+    practical_peak = 2 * 8192**3 / tm
+
     b, h, t, d = 4, 8, 4096, 128
     q, k, v = (
         jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d), jnp.bfloat16)
         for i in range(3)
     )
-    flops = 4 * b * h * t * t * d / 2  # causal
+    flops_causal = 4 * b * h * t * t * d / 2
+    flops_full = 4 * b * h * t * t * d
 
-    def measure(fn):
-        f = jax.jit(lambda q, k, v: fn(q, k, v, causal=True))
-        out = f(q, k, v)
-        float(np.asarray(out[0, 0, 0, 0]))  # host readback barrier
-        reps = 20
-        start = time.perf_counter()
-        for _ in range(reps):
-            out = f(q, k, v)
-        float(np.asarray(out[0, 0, 0, 0]))
-        return flops * reps / (time.perf_counter() - start)
+    def fwd_tflops(fn, causal):
+        f = jax.jit(lambda q, k, v: fn(q, k, v, causal=causal))
+        fl = flops_causal if causal else flops_full
+        return fl / timeit(f, q, k, v)
 
-    full_tf = measure(full_attention)
-    flash_tf = measure(flash_attention)
+    xla_tf = fwd_tflops(full_attention, True)
+    flash_causal = fwd_tflops(flash_attention, True)
+    flash_full = fwd_tflops(flash_attention, False)
+
+    # backward: a full grad step through the custom-VJP Pallas kernels.
+    # Standard flop count: fwd 2 matmul units, bwd 5 -> 3.5x fwd.
+    def grad_tflops(causal):
+        fl = 3.5 * (flops_causal if causal else flops_full)
+        loss = lambda q, k, v: flash_attention(
+            q, k, v, causal=causal
+        ).astype(jnp.float32).sum()
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return fl / timeit(g, q, k, v)
+
+    grad_causal = grad_tflops(True)
+
+    # long context: the packed triangular grid amortizes at large T
+    t2 = 16384
+    q2, k2, v2 = (
+        jax.random.normal(jax.random.PRNGKey(i), (1, 8, t2, d), jnp.bfloat16)
+        for i in range(3)
+    )
+    f16k = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    causal_16k = (4 * 8 * t2 * t2 * d / 2) / timeit(f16k, q2, k2, v2)
+
     return {
         "metric": "flash_attention_tflops",
-        "value": round(flash_tf / 1e12, 2),
-        "xla_full_attention_tflops": round(full_tf / 1e12, 2),
-        "speedup_vs_xla": round(flash_tf / full_tf, 2),
+        "value": round(flash_causal / 1e12, 2),
+        "fwd": {
+            "causal_t4096": round(flash_causal / 1e12, 2),
+            "full_t4096": round(flash_full / 1e12, 2),
+            "causal_t16384": round(causal_16k / 1e12, 2),
+        },
+        "bwd": {"grad_step_causal_t4096": round(grad_causal / 1e12, 2)},
+        "mfu": round(flash_causal / chip_peak, 3),
+        "mfu_full": round(flash_full / chip_peak, 3),
+        "mfu_t16384": round(causal_16k / chip_peak, 3),
+        "mfu_vs_measured_matmul": round(flash_causal / practical_peak, 3),
+        "mfu_t16384_vs_measured_matmul": round(causal_16k / practical_peak, 3),
+        "chip_peak_tflops": round(chip_peak / 1e12),
+        "practical_matmul_tflops": round(practical_peak / 1e12, 1),
+        "xla_full_attention_tflops": round(xla_tf / 1e12, 2),
+        "speedup_vs_xla": round(flash_causal / xla_tf, 2),
+        "note": "roofline analysis in ROOFLINE.md",
     }
 
 
