@@ -114,6 +114,83 @@ def forecast_deltas(
     return deltas.T  # (B, horizon)
 
 
+def cache_shardings(
+    model: TelemetrySequenceModel, mesh, axis: str = "dp"
+) -> DecodeCache:
+    """NamedSharding pytree for a :class:`DecodeCache`: the (B, H, max_len,
+    Dh) key/value tensors sharded over ``axis`` on their batch dim, the
+    write index replicated. With B streams forecast on a dp=P mesh each
+    device holds (B/P, H, max_len, Dh) — the cache, the serving-memory
+    wall, scales out with the mesh instead of replicating."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kv = NamedSharding(mesh, P(axis, None, None, None))
+    return DecodeCache(
+        tuple(kv for _ in range(model.layers)),
+        tuple(kv for _ in range(model.layers)),
+        NamedSharding(mesh, P()),
+    )
+
+
+def sharded_prefill(
+    model: TelemetrySequenceModel, mesh, max_len: int, axis: str = "dp"
+):
+    """Jit :func:`prefill` over ``mesh``: feats batch-sharded on ``axis``,
+    the returned cache dp-sharded per :func:`cache_shardings`.
+    Returns ``fn(params, feats) -> (last_pred, cache)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda params, feats: prefill(model, params, feats, max_len),
+        in_shardings=(repl, NamedSharding(mesh, P(axis, None, None))),
+        out_shardings=(
+            NamedSharding(mesh, P(axis)),
+            cache_shardings(model, mesh, axis),
+        ),
+    )
+
+
+def sharded_decode_step(model: TelemetrySequenceModel, mesh, axis: str = "dp"):
+    """Jit :func:`decode_step` over ``mesh`` with the cache staying
+    dp-sharded in AND out — every step reads/writes only the local
+    (B/P, H, max_len, Dh) shard. Returns ``fn(params, cache, feats_t)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    c_sh = cache_shardings(model, mesh, axis)
+    return jax.jit(
+        lambda params, cache, feats_t: decode_step(model, params, cache, feats_t),
+        in_shardings=(repl, c_sh, NamedSharding(mesh, P(axis, None))),
+        out_shardings=(NamedSharding(mesh, P(axis)), c_sh),
+    )
+
+
+def sharded_forecast_eta(
+    model: TelemetrySequenceModel,
+    mesh,
+    horizon: int,
+    target: float = 100.0,
+    axis: str = "dp",
+):
+    """Jit :func:`forecast_eta` over ``mesh`` with the observed streams
+    batch-sharded on ``axis``; GSPMD propagates the dp sharding through
+    prefill, the KV cache, and the whole rollout scan. Returns
+    ``fn(params, progress, statuses) -> (eta, reached)``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(axis, None))
+    out = NamedSharding(mesh, P(axis))
+    return jax.jit(
+        lambda params, prog, stats: forecast_eta(
+            model, params, prog, stats, horizon, target
+        ),
+        in_shardings=(repl, data, data),
+        out_shardings=(out, out),
+    )
+
+
 def forecast_eta(
     model: TelemetrySequenceModel,
     params,

@@ -46,6 +46,38 @@ from .train import TrainState, apply_gradients
 FEATURES = 1 + NUM_STATUSES
 
 
+def _seq_shard_constraint(mesh: Mesh | None, x: jax.Array) -> jax.Array:
+    """Megatron sequence parallelism for the non-matmul residue of TP:
+    constrain the residual stream / LayerNorm activations to be sharded
+    along the SEQUENCE dim over the tp axis (plus sp when ring/Ulysses
+    context parallelism is also active). GSPMD then lowers the row-parallel
+    layers' all-reduce into reduce-scatter + all-gather around the sharded
+    LayerNorms, so the replicated (B, T, D) activations between megatron's
+    two all-reduces never materialize — activation memory between blocks
+    drops by the tp factor (pinned by tests/test_parallel.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        return x
+    seq_axes = tuple(a for a in ("sp", "tp") if a in mesh.axis_names)
+    if not seq_axes:
+        return x
+    # drop any axis the array can't divide over (e.g. model.init traces
+    # with a batch of 1) — an unconstrained dim just stays replicated
+    batch = "dp" if "dp" in mesh.axis_names else None
+    if batch is not None and x.shape[0] % mesh.shape[batch]:
+        batch = None
+    seq_size = 1
+    for a in seq_axes:
+        seq_size *= mesh.shape[a]
+    if x.shape[1] % seq_size:
+        return x
+    seq = seq_axes if len(seq_axes) > 1 else seq_axes[0]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch, seq))
+    )
+
+
 class Block(nn.Module):
     dim: int
     heads: int
@@ -54,6 +86,9 @@ class Block(nn.Module):
     ffn: str = "dense"  # "dense" | "moe"
     num_experts: int = 4
     moe_topk: int = 1  # 1 = Switch, 2 = GShard top-2
+    #: shard LayerNorm/residual activations along T over tp (megatron
+    #: sequence parallelism); needs ``mesh``
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
@@ -62,6 +97,8 @@ class Block(nn.Module):
         :mod:`beholder_tpu.models.decode`)."""
         b, t, d = x.shape
         h = self.heads
+        if self.seq_shard:
+            x = _seq_shard_constraint(self.mesh, x)
         y = nn.LayerNorm()(x)
         # separate q/k/v projections (not one packed 3d Dense): with
         # megatron column sharding P(None, "tp") each tp shard then holds
@@ -112,6 +149,10 @@ class Block(nn.Module):
         att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
 
+        if self.seq_shard:
+            # row-parallel output lands sequence-sharded: GSPMD emits a
+            # reduce-scatter here instead of megatron's first all-reduce
+            x = _seq_shard_constraint(self.mesh, x)
         y = nn.LayerNorm()(x)
         if self.ffn == "moe":
             x = x + SwitchFFN(
@@ -122,6 +163,8 @@ class Block(nn.Module):
             y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
             y = nn.gelu(y)
             x = x + nn.Dense(d, name="down", dtype=jnp.bfloat16)(y).astype(x.dtype)
+        if self.seq_shard:
+            x = _seq_shard_constraint(self.mesh, x)
         if cache is not None or return_kv:
             return x, kv_out
         return x
@@ -143,6 +186,10 @@ class TelemetrySequenceModel(nn.Module):
     #: less activation memory — the standard long-context lever on TPU,
     #: where HBM, not FLOPs, is the wall
     remat: bool = False
+    #: megatron sequence parallelism: LayerNorm/residual activations
+    #: sharded along T over the tp axis (reduce-scatter/all-gather instead
+    #: of the two per-block all-reduces); needs ``mesh``
+    seq_shard: bool = False
 
     @nn.compact
     def __call__(self, feats: jax.Array, cache=None, return_kv: bool = False):
@@ -169,6 +216,7 @@ class TelemetrySequenceModel(nn.Module):
                 ffn=self.ffn,
                 num_experts=self.num_experts,
                 moe_topk=self.moe_topk,
+                seq_shard=self.seq_shard,
                 name=f"block_{i}",
             )
             if cache is not None:
@@ -179,6 +227,8 @@ class TelemetrySequenceModel(nn.Module):
                 kvs.append(kv)
             else:
                 x = block(x)
+        if self.seq_shard:
+            x = _seq_shard_constraint(self.mesh, x)
         x = nn.LayerNorm()(x)
         preds = nn.Dense(1, name="head", dtype=jnp.float32)(x)[..., 0]
         if cache is not None or return_kv:

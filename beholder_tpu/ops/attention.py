@@ -188,12 +188,27 @@ def _ring_local_bwd(qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal):
     return dq.astype(qb.dtype), dkc.astype(kb.dtype), dvc.astype(vb.dtype)
 
 
+def _lead_axes(mesh: Mesh, ndim: int) -> list:
+    """Sharding names for the leading (batch, heads) dims of a (..., T, d)
+    attention operand, so ring/Ulysses compose with dp (batch) and megatron
+    tp (heads are column-sharded over tp) on a 3-D ("dp","tp","sp") mesh.
+    Rank-3 (merged batch*heads) operands keep leading dims replicated."""
+    lead = [None] * (ndim - 2)
+    if ndim >= 4:
+        if "dp" in mesh.axis_names:
+            lead[0] = "dp"
+        if "tp" in mesh.axis_names:
+            lead[1] = "tp"
+    return lead
+
+
 @functools.lru_cache(maxsize=None)
 def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
     """custom-VJP ring attention bound to (mesh, axis, causal, rank)."""
     p_size = mesh.shape[axis]
-    spec = P(*([None] * (ndim - 2)), axis, None)
-    lse_spec = P(*([None] * (ndim - 2)), axis)
+    lead = _lead_axes(mesh, ndim)
+    spec = P(*lead, axis, None)
+    lse_spec = P(*lead, axis)
 
     def shard(fn, in_specs, out_specs):
         return jax.shard_map(
@@ -261,8 +276,13 @@ def ulysses_attention(
     """
     p_size = mesh.shape[axis]
     b, h, t, d = q.shape
-    if h % p_size:
-        raise ValueError(f"heads {h} not divisible by {axis}={p_size}")
+    # heads local to one device after any tp (megatron column) sharding:
+    # the all-to-all splits THAT dim, so it must divide by sp
+    h_local = h // mesh.shape.get("tp", 1) if "tp" in mesh.axis_names else h
+    if h_local % p_size:
+        raise ValueError(
+            f"per-device heads {h_local} not divisible by {axis}={p_size}"
+        )
     if t % p_size:
         raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
 
@@ -281,7 +301,7 @@ def ulysses_attention(
         # (B, H/P, T, d) -> (B, H, T/P, d)
         return jax.lax.all_to_all(att, axis, split_axis=2, concat_axis=1, tiled=True)
 
-    spec = P(None, None, axis, None)
+    spec = P(*_lead_axes(mesh, 4), axis, None)
     sharded = jax.shard_map(
         local,
         mesh=mesh,

@@ -19,8 +19,10 @@ from .mesh import (
     sharded_train_step,
 )
 from .pipeline import (
+    bubble_fraction,
     merge_microbatches,
     pipeline_forward,
+    pipeline_train_step,
     split_microbatches,
     stack_stage_params,
     stage_shardings,
@@ -43,6 +45,8 @@ __all__ = [
     "initialize",
     "make_hybrid_mesh",
     "pipeline_forward",
+    "pipeline_train_step",
+    "bubble_fraction",
     "stack_stage_params",
     "stage_shardings",
     "split_microbatches",

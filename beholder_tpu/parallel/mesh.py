@@ -116,14 +116,17 @@ def place_seq_state(state: Any, mesh: Mesh) -> Any:
 
 
 def sharded_seq_train_step(model, tx, mesh: Mesh, state_template: Any):
-    """Jit the sequence-model train step over a ("dp", "tp") mesh:
+    """Jit the sequence-model train step over a ("dp", "tp"[, "sp"]) mesh:
     batch dp-sharded, every Block's q/k/v/up column-parallel and
-    proj/down row-parallel. Returns fn(state, feats, targets)."""
+    proj/down row-parallel; on a 3-D mesh with an "sp" axis the sequence
+    dim of the data is context-parallel too (ring/Ulysses attention inside
+    megatron TP inside dp). Returns fn(state, feats, targets)."""
     from beholder_tpu.models.sequence import seq_train_step
 
     shardings = seq_state_shardings(state_template, mesh)
-    data = NamedSharding(mesh, P("dp", *([None] * 2)))
-    tgt = NamedSharding(mesh, P("dp", None))
+    seq = "sp" if "sp" in mesh.axis_names else None
+    data = NamedSharding(mesh, P("dp", seq, None))
+    tgt = NamedSharding(mesh, P("dp", seq))
     return jax.jit(
         lambda state, f, t: seq_train_step(model, tx, state, f, t),
         in_shardings=(shardings, data, tgt),

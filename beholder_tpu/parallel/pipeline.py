@@ -120,3 +120,136 @@ def pipeline_forward(
         out_specs=P(),
         check_vma=False,
     )(stacked_params, x)
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """Idle fraction of the 1F1B schedule in :func:`pipeline_train_step`.
+
+    Each device runs ``M`` forward and ``M`` backward units over
+    ``M + 2(S-1)`` ticks, each tick holding one F and one B slot: of the
+    ``2(M + 2(S-1))`` slots, ``4(S-1)`` are idle (2(S-1) empty F slots plus
+    2(S-1) empty B slots), so the bubble is ``2(S-1) / (M + 2(S-1))`` —
+    equivalently, per-device utilization is ``M / (M + 2(S-1))``.
+    """
+    s, m = num_stages, num_microbatches
+    return (2 * (s - 1)) / (m + 2 * (s - 1)) if s > 1 else 0.0
+
+
+def pipeline_train_step(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    y: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+) -> tuple[jax.Array, Any]:
+    """One 1F1B training step over ``S = mesh.shape[axis]`` pipeline stages.
+
+    Unlike running :func:`pipeline_forward` under ``jax.grad`` (GPipe: all
+    M microbatch activations live until the backward drain), this schedules
+    forward AND backward units in the same ``lax.scan``: tick ``t`` runs
+    stage ``i``'s forward for microbatch ``t - i`` and its backward for
+    microbatch ``t - 2(S-1) + i``, with activations hopping the ``ppermute``
+    ring forward and cotangents hopping it backward, one hop per tick. A
+    device therefore holds at most ``2(S-1)+1`` in-flight residuals —
+    activation memory is O(S), independent of M — and per-microbatch
+    gradients accumulate into the device's own stage shard.
+
+    Residuals store only the stage INPUT; the backward re-runs the stage
+    through ``jax.vjp`` (rematerialized 1F1B, the TPU-idiomatic trade: one
+    extra forward of FLOPs for an M-independent memory footprint).
+
+    ``loss_fn(out_mb, y_mb) -> scalar`` is applied on the last stage;
+    the step returns ``(loss, grads)`` where ``loss`` is the mean over
+    microbatches (replicated scalar) and ``grads`` matches
+    ``stacked_params`` — stacked on the stage axis and sharded
+    ``P(axis)``, so NO activation-sized collective runs at the end (the
+    masked-psum broadcast of :func:`pipeline_forward` is inference-only).
+
+    Grads equal running the S stages sequentially under ``jax.grad`` with
+    the same mean-over-microbatches loss (pinned by
+    ``tests/test_pipeline.py``).
+    """
+    s = mesh.shape[axis]
+    m = x.shape[0]
+    if y.shape[0] != m:
+        raise ValueError(f"x has {m} microbatches, y has {y.shape[0]}")
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                f"stage leaf has leading dim {leaf.shape[0]}, mesh {axis}={s}"
+            )
+    n_ticks = m + 2 * (s - 1)
+    r = min(2 * (s - 1) + 1, m)  # residual ring slots actually reachable
+
+    def local(params_l: Any, xs: jax.Array, ys: jax.Array):
+        params = jax.tree.map(lambda leaf: leaf[0], params_l)
+        idx = jax.lax.axis_index(axis)
+        fwd_ring = [(j, (j + 1) % s) for j in range(s)]
+        bwd_ring = [(j, (j - 1) % s) for j in range(s)]
+        is_last = idx == s - 1
+
+        zero_mb = jnp.zeros_like(xs[0])
+        resid0 = jnp.zeros((r, *xs.shape[1:]), xs.dtype)
+        gacc0 = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            fwd_in, bwd_in, resid, gacc, lacc = carry
+
+            # ---- forward unit: stage idx works on microbatch jf = t - idx
+            jf = t - idx
+            f_live = (jf >= 0) & (jf < m)
+            jf_c = jnp.clip(jf, 0, m - 1)
+            x_own = jax.lax.dynamic_index_in_dim(xs, jf_c, keepdims=False)
+            x_in = jnp.where(idx == 0, x_own, fwd_in)
+            out = stage_fn(params, x_in)
+
+            # park the stage input for this microbatch's backward
+            slot = jf_c % r
+            resid = jnp.where(
+                f_live,
+                jax.lax.dynamic_update_index_in_dim(resid, x_in, slot, 0),
+                resid,
+            )
+
+            # last stage seeds the cotangent from the loss in the SAME tick
+            # (its backward microbatch jb == jf)
+            y_own = jax.lax.dynamic_index_in_dim(ys, jf_c, keepdims=False)
+            loss_mb, seed = jax.value_and_grad(loss_fn)(out, y_own)
+            lacc = lacc + jnp.where(is_last & f_live, loss_mb, 0.0)
+
+            # ---- backward unit: microbatch jb = t - 2(S-1) + idx
+            jb = t - 2 * (s - 1) + idx
+            b_live = (jb >= 0) & (jb < m)
+            jb_c = jnp.clip(jb, 0, m - 1)
+            x_res = jax.lax.dynamic_index_in_dim(
+                resid, jb_c % r, keepdims=False
+            )
+            cot = jnp.where(is_last, seed, bwd_in)
+            _, vjp_fn = jax.vjp(stage_fn, params, x_res)
+            dparams, dx = vjp_fn(cot)
+            gacc = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_live, g, 0), gacc, dparams
+            )
+
+            fwd_out = jax.lax.ppermute(out, axis, fwd_ring) if s > 1 else out
+            bwd_out = jax.lax.ppermute(dx, axis, bwd_ring) if s > 1 else dx
+            return (fwd_out, bwd_out, resid, gacc, lacc), None
+
+        (_, _, _, gacc, lacc), _ = jax.lax.scan(
+            tick,
+            (zero_mb, zero_mb, resid0, gacc0, jnp.zeros(())),
+            jnp.arange(n_ticks),
+        )
+        loss = jax.lax.psum(lacc, axis) / m  # scalar — the only collective
+        grads = jax.tree.map(lambda g: (g / m)[None], gacc)
+        return loss, grads
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(stage_specs(stacked_params, axis), P(), P()),
+        out_specs=(P(), stage_specs(stacked_params, axis)),
+        check_vma=False,
+    )(stacked_params, x, y)

@@ -133,3 +133,138 @@ def test_pipeline_rejects_mismatched_stage_count(pp_mesh):
     x = jnp.zeros((2, 2, DIM))
     with pytest.raises(ValueError, match="leading dim"):
         pipeline_forward(stage_fn, stacked, x, pp_mesh)
+
+
+# ---------------------------------------------------------------------------
+# 1F1B train step
+# ---------------------------------------------------------------------------
+
+
+def mb_loss(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def test_1f1b_loss_and_grads_match_sequential(pp_mesh):
+    """The 1F1B schedule's (loss, grads) equal sequential execution under
+    jax.grad with the same mean-over-microbatches loss."""
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    stages = make_stage_params(jax.random.PRNGKey(10))
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(11), (8, 3, DIM))
+    y = jax.random.normal(jax.random.PRNGKey(12), (8, 3, DIM))
+
+    loss, grads = jax.jit(
+        lambda p, x, y: pipeline_train_step(
+            stage_fn, mb_loss, p, x, y, pp_mesh
+        )
+    )(stacked, x, y)
+
+    def seq_loss(p):
+        unstacked = [jax.tree.map(lambda l: l[i], p) for i in range(STAGES)]
+        out = sequential(unstacked, x)
+        return jnp.mean(jax.vmap(mb_loss)(out, y))
+
+    want_loss, want_grads = jax.value_and_grad(seq_loss)(stacked)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        grads,
+        want_grads,
+    )
+
+
+def test_1f1b_grads_stay_sharded_on_stage_axis(pp_mesh):
+    """Grad shards live on their stage's devices — no replication of the
+    stacked grads (the masked-psum broadcast is inference-only)."""
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    stages = make_stage_params(jax.random.PRNGKey(13))
+    stacked = stack_stage_params(stages)
+    stacked = jax.device_put(stacked, stage_shardings(stacked, pp_mesh))
+    x = jax.random.normal(jax.random.PRNGKey(14), (4, 2, DIM))
+    y = jax.random.normal(jax.random.PRNGKey(15), (4, 2, DIM))
+    _, grads = jax.jit(
+        lambda p, x, y: pipeline_train_step(
+            stage_fn, mb_loss, p, x, y, pp_mesh
+        )
+    )(stacked, x, y)
+    w_sharding = grads["w"].sharding
+    assert w_sharding.spec[0] == "pp", w_sharding.spec
+    # each device holds exactly its stage's slice, not the full stack
+    shard_shapes = {tuple(s.data.shape) for s in grads["w"].addressable_shards}
+    assert shard_shapes == {(1, DIM, DIM)}
+
+
+def test_1f1b_training_reduces_loss(pp_mesh):
+    import optax
+
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    stages = make_stage_params(jax.random.PRNGKey(16))
+    stacked = stack_stage_params(stages)
+    stacked = jax.device_put(stacked, stage_shardings(stacked, pp_mesh))
+    x = jax.random.normal(jax.random.PRNGKey(17), (8, 4, DIM))
+    y = jnp.roll(x, 1, axis=-1) * 0.5
+
+    tx = optax.adam(1e-2)
+    opt = tx.init(stacked)
+
+    @jax.jit
+    def step(p, opt):
+        loss, g = pipeline_train_step(stage_fn, mb_loss, p, x, y, pp_mesh)
+        updates, opt = tx.update(g, opt)
+        return optax.apply_updates(p, updates), opt, loss
+
+    losses = []
+    for _ in range(10):
+        stacked, opt, loss = step(stacked, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+    assert np.isfinite(losses[-1])
+
+
+def test_bubble_fraction():
+    from beholder_tpu.parallel.pipeline import bubble_fraction
+
+    # single stage never idles; more microbatches amortize the bubble
+    assert bubble_fraction(1, 4) == 0.0
+    assert bubble_fraction(4, 4) == pytest.approx(6 / 10)
+    fractions = [bubble_fraction(4, m) for m in (4, 8, 16, 64, 256)]
+    assert fractions == sorted(fractions, reverse=True)
+    assert fractions[-1] < 0.03
+    # GPipe-equivalent bound: 1F1B's bubble equals fill+drain over steady
+    # state, 2(S-1)/(M+2(S-1))
+    assert bubble_fraction(8, 32) == pytest.approx(14 / 46)
+
+
+def test_1f1b_odd_microbatch_counts(pp_mesh):
+    """M smaller than, equal to, and coprime with the stage count."""
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    stages = make_stage_params(jax.random.PRNGKey(18))
+    stacked = stack_stage_params(stages)
+    for m in (1, 3, 4, 7):
+        x = jax.random.normal(jax.random.PRNGKey(20 + m), (m, 2, DIM))
+        y = jax.random.normal(jax.random.PRNGKey(40 + m), (m, 2, DIM))
+        loss, grads = pipeline_train_step(
+            stage_fn, mb_loss, stacked, x, y, pp_mesh
+        )
+
+        def seq_loss(p):
+            unstacked = [
+                jax.tree.map(lambda l: l[i], p) for i in range(STAGES)
+            ]
+            return jnp.mean(jax.vmap(mb_loss)(sequential(unstacked, x), y))
+
+        want_loss, want_grads = jax.value_and_grad(seq_loss)(stacked)
+        np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            grads,
+            want_grads,
+        )
