@@ -23,9 +23,11 @@ Design (see /opt/skills/guides/pallas_guide.md):
   (qi >= kj) block pairs, driven by scalar-prefetched (qi, kj) lookup
   tables (``PrefetchScalarGridSpec``) — fully masked pairs never iterate,
   so the causal forward does ~half the work of the full grid and the
-  advantage grows with T (see ROOFLINE.md). The backward kernels keep the
-  rectangular grid with clamped BlockSpec indices (no DMA for dead steps)
-  plus a ``pl.when`` liveness guard.
+  advantage grows with T (see ROOFLINE.md). The causal backward kernels
+  use the same packed grids (qi-major for dq's resident q tile, kj-major
+  for dk/dv's resident kv tile); non-causal keeps plain rectangular
+  grids. All three kernels mask only where it can bite — the causal
+  diagonal block and, when T was padded, the last kv block.
 - The kernel emits the per-row logsumexp, making the backward
   recomputation exact.
 - Backward: TWO Pallas kernels with the same streaming discipline —
@@ -69,6 +71,22 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def _masked_dispatch(step, *, causal, qi, kj, n_blk, padded):
+    """Run ``step(masked)`` with masking only where it can bite: the causal
+    diagonal block and (when T was padded) the last kv block. Interior
+    blocks skip the iota/compare/select entirely. Padded q ROWS never need
+    a mask in the backward kernels: their lse is +BIG so the recomputed
+    probabilities underflow to exactly 0."""
+    needs_mask = (qi == kj) if causal else False
+    if padded:
+        needs_mask = needs_mask | (kj == n_blk - 1)
+    if needs_mask is False:
+        step(False)
+    else:
+        pl.when(needs_mask)(lambda: step(True))
+        pl.when(jnp.logical_not(needs_mask))(lambda: step(False))
+
+
 def _tri_tables(n_blk):
     """Host-side (qi, kj) lookup tables for the packed causal grid.
 
@@ -83,6 +101,17 @@ def _tri_tables(n_blk):
     qi = np.repeat(np.arange(n_blk), np.arange(1, n_blk + 1))
     kj = np.concatenate([np.arange(i + 1) for i in range(n_blk)])
     return jnp.asarray(qi, jnp.int32), jnp.asarray(kj, jnp.int32)
+
+
+def _tri_tables_kv_major(n_blk):
+    """(kj, qi) tables for the dk/dv kernel's packed grid: kv-tile-resident,
+    so the enumeration is kj-major with qi running kj..n_blk-1 —
+    (0,0),(0,1),...,(0,n-1),(1,1),... Only live (qi >= kj) pairs appear."""
+    import numpy as np
+
+    kj = np.repeat(np.arange(n_blk), np.arange(n_blk, 0, -1))
+    qi = np.concatenate([np.arange(j, n_blk) for j in range(n_blk)])
+    return jnp.asarray(kj, jnp.int32), jnp.asarray(qi, jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -179,16 +208,11 @@ def _fwd_kernel(
             s = s_next
 
     # the packed causal grid contains only live (qi >= kj) pairs, so no
-    # liveness guard is needed; masking applies on the diagonal block and
-    # (when T was padded) the last kv block
-    needs_mask = (qi == kj) if causal else False
-    if t_pad != t_real:
-        needs_mask = needs_mask | (kj == n_blk - 1)
-    if needs_mask is False:
-        _chunks(False)
-    else:
-        pl.when(needs_mask)(lambda: _chunks(True))
-        pl.when(jnp.logical_not(needs_mask))(lambda: _chunks(False))
+    # liveness guard is needed
+    _masked_dispatch(
+        _chunks, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
+        padded=t_pad != t_real,
+    )
 
     @pl.when(kj == last_kv)
     def _finalize():
@@ -297,36 +321,39 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, t_real, causal, scale, block,
+    qi_kj, *, t_real, t_pad, causal, scale, block,
 ):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    n_kv = pl.num_programs(2)
+    n_blk = t_pad // block
+    if causal:
+        qi, kj = qi_kj            # packed triangular grid (see forward)
+        last_kv = qi
+    else:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        last_kv = pl.num_programs(2) - 1
 
     @pl.when(kj == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    live = (qi >= kj) if causal else True
-
-    @pl.when(live)
-    def _step():
+    def _step(masked: bool):
         q = q_ref[0]
         kb = k_ref[0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        rows = qi * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 0
-        )
-        cols = kj * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 1
-        )
-        valid = cols < t_real
-        if causal:
-            valid = valid & (rows >= cols)
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            rows = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            cols = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            valid = cols < t_real
+            if causal:
+                valid = valid & (rows >= cols)
+            s = jnp.where(valid, s, _NEG_INF)
         # p: exact probabilities recomputed from the saved logsumexp
         # (padded q rows carry lse=+BIG so p underflows to exactly 0)
         p = jnp.exp(s - lse_ref[0][:, :1])             # (bq, bk) f32
@@ -340,7 +367,12 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(kj == n_kv - 1)
+    _masked_dispatch(
+        _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
+        padded=t_pad != t_real,
+    )
+
+    @pl.when(kj == last_kv)
     def _finalize():
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
@@ -352,21 +384,24 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, *, t_real, causal, scale, block,
+    dk_acc, dv_acc, kj_qi, *, t_real, t_pad, causal, scale, block,
 ):
-    kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    n_blk = t_pad // block
+    if causal:
+        kj, qi = kj_qi            # packed upper-triangle grid, q innermost
+        first_q = kj              # row kj's first contributing q block
+    else:
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+        first_q = 0
+    n_q = n_blk
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first_q)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = (qi >= kj) if causal else True
-
-    @pl.when(live)
-    def _step():
+    def _step(masked: bool):
         q = q_ref[0]
         kb = k_ref[0]
         do = do_ref[0]
@@ -374,16 +409,17 @@ def _dkv_kernel(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        rows = qi * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 0
-        )
-        cols = kj * block + jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 1
-        )
-        valid = cols < t_real
-        if causal:
-            valid = valid & (rows >= cols)
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            rows = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0
+            )
+            cols = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1
+            )
+            valid = cols < t_real
+            if causal:
+                valid = valid & (rows >= cols)
+            s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk) f32
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -398,6 +434,11 @@ def _dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                               # (bk, d)
+
+    _masked_dispatch(
+        _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
+        padded=t_pad != t_real,
+    )
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -421,18 +462,87 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
     delta_b = jnp.broadcast_to(delta[:, :, None], (bh, t_pad, _LANES))
     lse_b = jnp.broadcast_to(lse[:, :, None], (bh, t_pad, _LANES))
 
-    q_res = lambda b, i, j: (b, i, 0)        # follows the resident tile
-    if causal:
-        kv_stream = lambda b, i, j: (b, jnp.minimum(j, i), 0)
-    else:
-        kv_stream = lambda b, i, j: (b, j, 0)
-
     tile = lambda index_map: pl.BlockSpec((1, block, d_pad), index_map)
     rows = lambda index_map: pl.BlockSpec((1, block, _LANES), index_map)
+    dq_scratch = [pltpu.VMEM((block, d_pad), jnp.float32)]
+    dkv_scratch = [
+        pltpu.VMEM((block, d_pad), jnp.float32),
+        pltpu.VMEM((block, d_pad), jnp.float32),
+    ]
+    dkv_out_shape = [
+        jax.ShapeDtypeStruct(k.shape, k.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+
+    if causal:
+        # packed triangular grids (same trick as the forward): one grid
+        # step per LIVE (qi, kj) pair, (qi, kj) scalar-prefetched
+        n_live = n_blk * (n_blk + 1) // 2
+        qi_tab, kj_tab = _tri_tables(n_blk)
+        q_map = lambda b, l, at, bt: (b, at[l], 0)
+        kv_map = lambda b, l, at, bt: (b, bt[l], 0)
+
+        def dq_kernel(at_ref, bt_ref, *refs):
+            lin = pl.program_id(1)
+            _dq_kernel(
+                *refs, (at_ref[lin], bt_ref[lin]),
+                t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
+                block=block,
+            )
+
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, n_live),
+                in_specs=[
+                    tile(q_map), tile(kv_map), tile(kv_map),
+                    tile(q_map), rows(q_map), rows(q_map),
+                ],
+                out_specs=tile(q_map),
+                scratch_shapes=dq_scratch,
+            ),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(qi_tab, kj_tab, q, k, v, do, lse_b, delta_b)
+
+        # dk/dv: kv tile resident -> kj-major enumeration, q innermost
+        kj_tab2, qi_tab2 = _tri_tables_kv_major(n_blk)
+        kv_map2 = lambda b, l, kt, qt: (b, kt[l], 0)
+        q_map2 = lambda b, l, kt, qt: (b, qt[l], 0)
+
+        def dkv_kernel(kt_ref, qt_ref, *refs):
+            lin = pl.program_id(1)
+            _dkv_kernel(
+                *refs, (kt_ref[lin], qt_ref[lin]),
+                t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
+                block=block,
+            )
+
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(bh, n_live),
+                in_specs=[
+                    tile(q_map2), tile(kv_map2), tile(kv_map2),
+                    tile(q_map2), rows(q_map2), rows(q_map2),
+                ],
+                out_specs=[tile(kv_map2), tile(kv_map2)],
+                scratch_shapes=dkv_scratch,
+            ),
+            out_shape=dkv_out_shape,
+            interpret=interpret,
+        )(kj_tab2, qi_tab2, q, k, v, do, lse_b, delta_b)
+        return dq, dk, dv
+
+    q_res = lambda b, i, j: (b, i, 0)        # follows the resident tile
+    kv_stream = lambda b, i, j: (b, j, 0)
 
     dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, t_real=t_real, causal=causal, scale=scale, block=block
+        lambda *refs: _dq_kernel(
+            *refs, None, t_real=t_real, t_pad=t_pad, causal=causal,
+            scale=scale, block=block,
         ),
         grid=(bh, n_blk, n_blk),
         in_specs=[
@@ -441,21 +551,17 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         ],
         out_specs=tile(q_res),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block, d_pad), jnp.float32)],
+        scratch_shapes=dq_scratch,
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
 
     kv_res = lambda b, j, i: (b, j, 0)       # resident kv tile
-    if causal:
-        # q blocks before the kv tile's diagonal are fully masked; clamp
-        # to the first contributing block (no DMA for the skipped steps)
-        q_stream = lambda b, j, i: (b, jnp.maximum(i, j), 0)
-    else:
-        q_stream = lambda b, j, i: (b, i, 0)
+    q_stream = lambda b, j, i: (b, i, 0)
 
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, t_real=t_real, causal=causal, scale=scale, block=block
+        lambda *refs: _dkv_kernel(
+            *refs, None, t_real=t_real, t_pad=t_pad, causal=causal,
+            scale=scale, block=block,
         ),
         grid=(bh, n_blk, n_blk),
         in_specs=[
@@ -463,14 +569,8 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
             tile(q_stream), rows(q_stream), rows(q_stream),
         ],
         out_specs=[tile(kv_res), tile(kv_res)],
-        out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block, d_pad), jnp.float32),
-            pltpu.VMEM((block, d_pad), jnp.float32),
-        ],
+        out_shape=dkv_out_shape,
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
     return dq, dk, dv

@@ -54,6 +54,21 @@ def test_flash_gradients_match_full():
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
 
 
+def test_flash_noncausal_gradients_with_padded_t():
+    """Non-causal backward with T not a block multiple: the rectangular
+    grids' padding mask (last kv block) must keep dq/dk/dv exact — the
+    causal tests never reach this branch."""
+    q, k, v = _qkv(8, b=1, h=2, t=150, d=16)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=False) ** 2)
+
+    want = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
 def test_flash_never_materializes_scores():
     """The jaxpr must contain no (T, T) intermediate."""
     q, k, v = _qkv(3, b=1, h=1, t=256, d=16)
