@@ -54,14 +54,17 @@ def test_flash_gradients_match_full():
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
 
 
-def test_flash_noncausal_gradients_with_padded_t():
-    """Non-causal backward with T not a block multiple: the rectangular
-    grids' padding mask (last kv block) must keep dq/dk/dv exact — the
-    causal tests never reach this branch."""
-    q, k, v = _qkv(8, b=1, h=2, t=150, d=16)
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_multiblock_gradients_with_padded_t(causal):
+    """Backward at T=300 (pads to 384 -> block 128 -> a 3x3 block grid):
+    exercises the packed triangular grids' table order, per-row
+    accumulator init/finalize, the UNMASKED interior-block fast path, and
+    the last-kv-block padding mask — none of which exist at n_blk == 1,
+    where every smaller test collapses to a single masked step."""
+    q, k, v = _qkv(8, b=1, h=2, t=300, d=16)
 
     def loss(fn):
-        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=False) ** 2)
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) ** 2)
 
     want = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
     got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
