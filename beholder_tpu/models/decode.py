@@ -115,16 +115,22 @@ def forecast_deltas(
 
 
 def cache_shardings(
-    model: TelemetrySequenceModel, mesh, axis: str = "dp"
+    model: TelemetrySequenceModel, mesh, axis: str = "dp",
+    head_axis: str | None = None,
 ) -> DecodeCache:
     """NamedSharding pytree for a :class:`DecodeCache`: the (B, H, max_len,
-    Dh) key/value tensors sharded over ``axis`` on their batch dim, the
-    write index replicated. With B streams forecast on a dp=P mesh each
-    device holds (B/P, H, max_len, Dh) — the cache, the serving-memory
-    wall, scales out with the mesh instead of replicating."""
+    Dh) key/value tensors sharded over ``axis`` on their batch dim — and,
+    when ``head_axis`` is given (tensor-parallel serving), over it on the
+    HEAD dim (matching megatron column-parallel q/k/v, whose shards each
+    produce whole heads). The write index is replicated. With B streams on
+    a dp=P (×tp=T) mesh each device holds (B/P, H/T, max_len, Dh) — the
+    cache, the serving-memory wall, scales out with the mesh instead of
+    replicating. ``head_axis`` follows the PARAMS placement, not the mesh
+    shape: head-sharding the cache of replicated params would insert a
+    k/v reshard into every decode step."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    kv = NamedSharding(mesh, P(axis, None, None, None))
+    kv = NamedSharding(mesh, P(axis, head_axis, None, None))
     return DecodeCache(
         tuple(kv for _ in range(model.layers)),
         tuple(kv for _ in range(model.layers)),
@@ -132,36 +138,56 @@ def cache_shardings(
     )
 
 
+def _serving_head_axis(mesh, params_shardings) -> str | None:
+    """Head-shard the cache over tp only when the caller actually placed
+    the params tensor-parallel (replicated params + a head-sharded cache
+    would reshard k/v every step)."""
+    return "tp" if params_shardings is not None and "tp" in mesh.axis_names else None
+
+
 def sharded_prefill(
-    model: TelemetrySequenceModel, mesh, max_len: int, axis: str = "dp"
+    model: TelemetrySequenceModel,
+    mesh,
+    max_len: int,
+    axis: str = "dp",
+    params_shardings=None,
 ):
     """Jit :func:`prefill` over ``mesh``: feats batch-sharded on ``axis``,
-    the returned cache dp-sharded per :func:`cache_shardings`.
+    the returned cache sharded per :func:`cache_shardings`. For a 2-D
+    (dp, tp) serving mesh pass megatron ``params_shardings`` (from
+    :func:`beholder_tpu.parallel.seq_state_shardings` on the params tree)
+    so the model weights are tensor-parallel while the cache heads follow.
     Returns ``fn(params, feats) -> (last_pred, cache)``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    repl = NamedSharding(mesh, P())
+    p_sh = params_shardings or NamedSharding(mesh, P())
+    head_axis = _serving_head_axis(mesh, params_shardings)
     return jax.jit(
         lambda params, feats: prefill(model, params, feats, max_len),
-        in_shardings=(repl, NamedSharding(mesh, P(axis, None, None))),
+        in_shardings=(p_sh, NamedSharding(mesh, P(axis, None, None))),
         out_shardings=(
             NamedSharding(mesh, P(axis)),
-            cache_shardings(model, mesh, axis),
+            cache_shardings(model, mesh, axis, head_axis),
         ),
     )
 
 
-def sharded_decode_step(model: TelemetrySequenceModel, mesh, axis: str = "dp"):
+def sharded_decode_step(
+    model: TelemetrySequenceModel,
+    mesh,
+    axis: str = "dp",
+    params_shardings=None,
+):
     """Jit :func:`decode_step` over ``mesh`` with the cache staying
-    dp-sharded in AND out — every step reads/writes only the local
-    (B/P, H, max_len, Dh) shard. Returns ``fn(params, cache, feats_t)``."""
+    sharded in AND out — every step reads/writes only the local
+    (B/dp, H/tp, max_len, Dh) shard. Returns ``fn(params, cache, feats_t)``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    repl = NamedSharding(mesh, P())
-    c_sh = cache_shardings(model, mesh, axis)
+    p_sh = params_shardings or NamedSharding(mesh, P())
+    c_sh = cache_shardings(model, mesh, axis, _serving_head_axis(mesh, params_shardings))
     return jax.jit(
         lambda params, cache, feats_t: decode_step(model, params, cache, feats_t),
-        in_shardings=(repl, c_sh, NamedSharding(mesh, P(axis, None))),
+        in_shardings=(p_sh, c_sh, NamedSharding(mesh, P(axis, None))),
         out_shardings=(NamedSharding(mesh, P(axis)), c_sh),
     )
 
@@ -172,21 +198,24 @@ def sharded_forecast_eta(
     horizon: int,
     target: float = 100.0,
     axis: str = "dp",
+    params_shardings=None,
 ):
     """Jit :func:`forecast_eta` over ``mesh`` with the observed streams
     batch-sharded on ``axis``; GSPMD propagates the dp sharding through
-    prefill, the KV cache, and the whole rollout scan. Returns
+    prefill, the KV cache, and the whole rollout scan. Pass megatron
+    ``params_shardings`` for tensor-parallel serving (otherwise params
+    are replicated). Returns
     ``fn(params, progress, statuses) -> (eta, reached)``."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    repl = NamedSharding(mesh, P())
+    p_sh = params_shardings or NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(axis, None))
     out = NamedSharding(mesh, P(axis))
     return jax.jit(
         lambda params, prog, stats: forecast_eta(
             model, params, prog, stats, horizon, target
         ),
-        in_shardings=(repl, data, data),
+        in_shardings=(p_sh, data, data),
         out_shardings=(out, out),
     )
 

@@ -224,3 +224,49 @@ def test_sharded_forecast_eta_matches_unsharded(dp_mesh, sharded_setup):
     np.testing.assert_array_equal(np.asarray(eta), np.asarray(eta_ref))
     np.testing.assert_array_equal(np.asarray(reached), np.asarray(reached_ref))
     assert eta.sharding.spec[0] == "dp"
+
+
+def test_2d_serving_dp_tp_cache_and_numerics(sharded_setup):
+    """Serving on a (dp, tp) mesh: megatron-TP params, cache sharded over
+    batch AND heads — each device holds (B/dp, H/tp, max_len, Dh); the
+    rollout equals the unsharded one."""
+    from jax.sharding import Mesh
+
+    from beholder_tpu.models.decode import sharded_decode_step, sharded_prefill
+    from beholder_tpu.parallel import seq_state_shardings
+
+    model, params, prog, stats = sharded_setup
+    mesh2 = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    p_sh = seq_state_shardings(params, mesh2)
+    params2 = jax.device_put(params, p_sh)
+
+    feats, _ = stream_features(prog, stats)
+    t = feats.shape[1]
+    split = 12
+
+    _, ref_cache = prefill(model, params, feats[:, :split], max_len=t)
+    ref_preds = []
+    for i in range(split, t):
+        p, ref_cache = decode_step(model, params, ref_cache, feats[:, i])
+        ref_preds.append(p)
+
+    pre = sharded_prefill(model, mesh2, t, params_shardings=p_sh)
+    step = sharded_decode_step(model, mesh2, params_shardings=p_sh)
+    _, cache = pre(params2, feats[:, :split])
+    # executed cache shardings: batch over dp AND heads over tp
+    spec = cache.keys[0].sharding.spec
+    assert spec[0] == "dp" and spec[1] == "tp", spec
+    shard_shapes = {
+        tuple(s.data.shape) for s in cache.keys[0].addressable_shards
+    }
+    assert shard_shapes == {(2, 1, t, 16)}  # B=8/dp=4, H=2/tp=2
+
+    got_preds = []
+    for i in range(split, t):
+        p, cache = step(params2, cache, feats[:, i])
+        got_preds.append(p)
+    np.testing.assert_allclose(
+        np.asarray(jnp.stack(got_preds)),
+        np.asarray(jnp.stack(ref_preds)),
+        rtol=2e-2, atol=5e-3,
+    )
