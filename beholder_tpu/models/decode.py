@@ -138,11 +138,21 @@ def cache_shardings(
     )
 
 
-def _serving_head_axis(mesh, params_shardings) -> str | None:
-    """Head-shard the cache over tp only when the caller actually placed
-    the params tensor-parallel (replicated params + a head-sharded cache
-    would reshard k/v every step)."""
-    return "tp" if params_shardings is not None and "tp" in mesh.axis_names else None
+def _serving_head_axis(mesh, params_shardings, batch_axis) -> str | None:
+    """Head-shard the cache over tp only when the provided params
+    shardings ACTUALLY use the tp axis (replicated params + a head-sharded
+    cache would reshard k/v every step)."""
+    if (
+        params_shardings is None
+        or "tp" not in mesh.axis_names
+        or batch_axis == "tp"
+    ):
+        return None
+    uses_tp = any(
+        "tp" in str(getattr(leaf, "spec", ""))
+        for leaf in jax.tree.leaves(params_shardings)
+    )
+    return "tp" if uses_tp else None
 
 
 def sharded_prefill(
@@ -161,7 +171,7 @@ def sharded_prefill(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     p_sh = params_shardings or NamedSharding(mesh, P())
-    head_axis = _serving_head_axis(mesh, params_shardings)
+    head_axis = _serving_head_axis(mesh, params_shardings, axis)
     return jax.jit(
         lambda params, feats: prefill(model, params, feats, max_len),
         in_shardings=(p_sh, NamedSharding(mesh, P(axis, None, None))),
@@ -184,7 +194,9 @@ def sharded_decode_step(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     p_sh = params_shardings or NamedSharding(mesh, P())
-    c_sh = cache_shardings(model, mesh, axis, _serving_head_axis(mesh, params_shardings))
+    c_sh = cache_shardings(
+        model, mesh, axis, _serving_head_axis(mesh, params_shardings, axis)
+    )
     return jax.jit(
         lambda params, cache, feats_t: decode_step(model, params, cache, feats_t),
         in_shardings=(p_sh, c_sh, NamedSharding(mesh, P(axis, None))),

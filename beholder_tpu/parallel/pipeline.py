@@ -143,6 +143,7 @@ def pipeline_train_step(
     y: jax.Array,
     mesh: Mesh,
     axis: str = "pp",
+    dp_axis: str | None = None,
 ) -> tuple[jax.Array, Any]:
     """One 1F1B training step over ``S = mesh.shape[axis]`` pipeline stages.
 
@@ -170,11 +171,33 @@ def pipeline_train_step(
     Grads equal running the S stages sequentially under ``jax.grad`` with
     the same mean-over-microbatches loss (pinned by
     ``tests/test_pipeline.py``).
+
+    With ``dp_axis`` (a second mesh axis), the per-microbatch batch dim is
+    additionally data-parallel: each dp replica pipelines its own batch
+    shard through the same 1F1B schedule, and gradients are averaged over
+    dp with one psum at the end — the Megatron dp×pp composition. Stage
+    params stay sharded over ``axis`` only (replicated across dp).
+    NOTE: under ``dp_axis`` the per-shard losses are AVERAGED over dp, so
+    ``loss_fn`` must be a mean over its batch dim (the usual convention);
+    a sum-type loss would come out a factor of dp small.
     """
     s = mesh.shape[axis]
     m = x.shape[0]
     if y.shape[0] != m:
         raise ValueError(f"x has {m} microbatches, y has {y.shape[0]}")
+    dp = 1
+    if dp_axis is not None:
+        if dp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"dp_axis {dp_axis!r} not in mesh axes {mesh.axis_names}"
+            )
+        dp = mesh.shape[dp_axis]
+        for name, arr in (("x", x), ("y", y)):
+            if arr.ndim < 2 or arr.shape[1] % dp:
+                raise ValueError(
+                    f"{name} microbatch dim {arr.shape[1:2]} not divisible "
+                    f"by {dp_axis}={dp}"
+                )
     for leaf in jax.tree.leaves(stacked_params):
         if leaf.shape[0] != s:
             raise ValueError(
@@ -242,14 +265,23 @@ def pipeline_train_step(
             (zero_mb, zero_mb, resid0, gacc0, jnp.zeros(())),
             jnp.arange(n_ticks),
         )
-        loss = jax.lax.psum(lacc, axis) / m  # scalar — the only collective
-        grads = jax.tree.map(lambda g: (g / m)[None], gacc)
+        # the only collectives: one scalar psum for the loss, and (under
+        # dp) one grad-sized psum averaging the dp replicas' accumulators
+        if dp_axis is None:
+            loss = jax.lax.psum(lacc, axis) / m
+            grads = jax.tree.map(lambda g: (g / m)[None], gacc)
+        else:
+            loss = jax.lax.psum(lacc, (axis, dp_axis)) / (m * dp)
+            grads = jax.tree.map(
+                lambda g: (jax.lax.psum(g, dp_axis) / (m * dp))[None], gacc
+            )
         return loss, grads
 
+    data_spec = P(None, dp_axis) if dp_axis is not None else P()
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(stage_specs(stacked_params, axis), P(), P()),
+        in_specs=(stage_specs(stacked_params, axis), data_spec, data_spec),
         out_specs=(P(), stage_specs(stacked_params, axis)),
         check_vma=False,
     )(stacked_params, x, y)
