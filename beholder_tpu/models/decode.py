@@ -32,7 +32,10 @@ from .sequence import FEATURES, TelemetrySequenceModel
 
 
 class DecodeCache(NamedTuple):
-    """Per-layer key/value tensors (B, H, max_len, Dh) + write index."""
+    """Per-layer key/value tensors (B, Hkv, max_len, Dh) + write index.
+
+    Hkv is ``model.kv_heads or model.heads`` — under grouped-query
+    attention the cache holds only the kv heads."""
 
     keys: tuple
     values: tuple
@@ -42,8 +45,12 @@ class DecodeCache(NamedTuple):
 def init_cache(
     model: TelemetrySequenceModel, batch: int, max_len: int
 ) -> DecodeCache:
+    """With grouped-query attention (``model.kv_heads < heads``) the cache
+    holds only the kv heads — the (B, Hkv, max_len, Dh) tensors shrink by
+    the group factor, which is THE serving-memory lever."""
     dh = model.dim // model.heads
-    shape = (batch, model.heads, max_len, dh)
+    hkv = model.kv_heads or model.heads
+    shape = (batch, hkv, max_len, dh)
     zeros = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
     return DecodeCache(zeros, tuple(jnp.zeros_like(z) for z in zeros), jnp.int32(0))
 
@@ -118,18 +125,27 @@ def cache_shardings(
     model: TelemetrySequenceModel, mesh, axis: str = "dp",
     head_axis: str | None = None,
 ) -> DecodeCache:
-    """NamedSharding pytree for a :class:`DecodeCache`: the (B, H, max_len,
-    Dh) key/value tensors sharded over ``axis`` on their batch dim — and,
-    when ``head_axis`` is given (tensor-parallel serving), over it on the
-    HEAD dim (matching megatron column-parallel q/k/v, whose shards each
-    produce whole heads). The write index is replicated. With B streams on
-    a dp=P (×tp=T) mesh each device holds (B/P, H/T, max_len, Dh) — the
-    cache, the serving-memory wall, scales out with the mesh instead of
-    replicating. ``head_axis`` follows the PARAMS placement, not the mesh
-    shape: head-sharding the cache of replicated params would insert a
-    k/v reshard into every decode step."""
+    """NamedSharding pytree for a :class:`DecodeCache`: the (B, Hkv,
+    max_len, Dh) key/value tensors sharded over ``axis`` on their batch
+    dim — and, when ``head_axis`` is given (tensor-parallel serving), over
+    it on the HEAD dim (matching megatron column-parallel q/k/v, whose
+    shards each produce whole kv heads). The write index is replicated.
+    With B streams on a dp=P (×tp=T) mesh each device holds
+    (B/P, Hkv/T, max_len, Dh) — the cache, the serving-memory wall, scales
+    out with the mesh instead of replicating, and shrinks by heads/kv_heads
+    under GQA on top. ``head_axis`` follows the PARAMS placement, not the
+    mesh shape: head-sharding the cache of replicated params would insert
+    a k/v reshard into every decode step."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    if head_axis is not None:
+        hkv = model.kv_heads or model.heads
+        if hkv % mesh.shape[head_axis]:
+            raise ValueError(
+                f"kv heads ({hkv}) must divide by mesh axis "
+                f"'{head_axis}'={mesh.shape[head_axis]} for head-sharded "
+                f"serving — with GQA pick kv_heads as a multiple of tp"
+            )
     kv = NamedSharding(mesh, P(axis, head_axis, None, None))
     return DecodeCache(
         tuple(kv for _ in range(model.layers)),

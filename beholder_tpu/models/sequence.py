@@ -89,6 +89,11 @@ class Block(nn.Module):
     #: shard LayerNorm/residual activations along T over tp (megatron
     #: sequence parallelism); needs ``mesh``
     seq_shard: bool = False
+    #: grouped-query attention: k/v carry this many heads (< heads; 1 =
+    #: MQA). Shrinks the KV cache by heads/kv_heads — the serving-memory
+    #: lever. With megatron tp, kv_heads % tp must be 0 so each shard
+    #: holds whole kv heads.
+    kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
@@ -97,6 +102,10 @@ class Block(nn.Module):
         :mod:`beholder_tpu.models.decode`)."""
         b, t, d = x.shape
         h = self.heads
+        hkv = self.kv_heads or h
+        dh = d // h
+        if h % hkv:
+            raise ValueError(f"heads {h} not a multiple of kv_heads {hkv}")
         if self.seq_shard:
             x = _seq_shard_constraint(self.mesh, x)
         y = nn.LayerNorm()(x)
@@ -105,11 +114,12 @@ class Block(nn.Module):
         # whole heads of each of q, k, v — a packed kernel's thirds would
         # straddle shard boundaries and force resharding before attention
         q = nn.Dense(d, name="q_proj", dtype=jnp.bfloat16)(y)
-        k = nn.Dense(d, name="k_proj", dtype=jnp.bfloat16)(y)
-        v = nn.Dense(d, name="v_proj", dtype=jnp.bfloat16)(y)
+        k = nn.Dense(hkv * dh, name="k_proj", dtype=jnp.bfloat16)(y)
+        v = nn.Dense(hkv * dh, name="v_proj", dtype=jnp.bfloat16)(y)
         # (B, T, D) -> (B, H, T, Dh): leading dims pass through attention
-        q, k, v = (
-            a.reshape(b, t, h, d // h).transpose(0, 2, 1, 3) for a in (q, k, v)
+        q = q.reshape(b, t, h, dh).transpose(0, 2, 1, 3)
+        k, v = (
+            a.reshape(b, t, hkv, dh).transpose(0, 2, 1, 3) for a in (k, v)
         )
         if cache is not None:
             k_cache, v_cache, index = cache
@@ -123,20 +133,32 @@ class Block(nn.Module):
             # forward): score matmul in the cache dtype (bf16 on the MXU),
             # f32 softmax, weights cast back before the PV matmul — so
             # incremental decode reproduces the full causal forward bit-for
-            # -bit up to accumulation order.
+            # -bit up to accumulation order. The group dim g = H/Hkv makes
+            # every q head in a group read its shared kv-cache head (g=1
+            # degenerates to plain MHA).
+            g = h // hkv
+            qg = q.astype(k_cache.dtype).reshape(b, hkv, g, t, dh)
             scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q.astype(k_cache.dtype), k_cache
-            ) / jnp.sqrt(jnp.float32(d // h))
+                "bhgqd,bhkd->bhgqk", qg, k_cache
+            ) / jnp.sqrt(jnp.float32(dh))
             positions = jnp.arange(k_cache.shape[2])
             scores = jnp.where(positions <= index, scores, -1e30)
             weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
             att = jnp.einsum(
-                "bhqk,bhkd->bhqd", weights.astype(q.dtype), v_cache
-            )
+                "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), v_cache
+            ).reshape(b, h, t, dh)
             kv_out = (k_cache, v_cache)
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")
+            kv_out = (k, v)  # cache k/v keep their hkv heads
+            if self.attention in ("ring", "ulysses") and hkv != h:
+                # the sp collectives (ppermute / all-to-all) move k/v by
+                # whole heads; broadcast kv groups up front so every
+                # device's rotation carries complete heads. The kv-memory
+                # saving is a CACHE property — training keeps full FLOPs.
+                k = jnp.repeat(k, h // hkv, axis=1)
+                v = jnp.repeat(v, h // hkv, axis=1)
             if self.attention == "ring":
                 att = ring_attention(q, k, v, self.mesh, causal=True)
             elif self.attention == "ulysses":
@@ -145,7 +167,6 @@ class Block(nn.Module):
                 att = flash_attention(q, k, v, causal=True)
             else:
                 att = full_attention(q, k, v, causal=True)
-            kv_out = (k, v)
         att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
 
@@ -190,6 +211,9 @@ class TelemetrySequenceModel(nn.Module):
     #: sharded along T over the tp axis (reduce-scatter/all-gather instead
     #: of the two per-block all-reduces); needs ``mesh``
     seq_shard: bool = False
+    #: grouped-query attention (GQA; 1 = MQA): k/v heads per block. The
+    #: KV cache shrinks by heads/kv_heads (see models/decode.py)
+    kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, feats: jax.Array, cache=None, return_kv: bool = False):
@@ -217,6 +241,7 @@ class TelemetrySequenceModel(nn.Module):
                 num_experts=self.num_experts,
                 moe_topk=self.moe_topk,
                 seq_shard=self.seq_shard,
+                kv_heads=self.kv_heads,
                 name=f"block_{i}",
             )
             if cache is not None:

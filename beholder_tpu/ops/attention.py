@@ -37,15 +37,37 @@ _NEG_INF = -1e30
 def full_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
 ) -> jax.Array:
-    """Reference O(T^2) attention. Shapes: (..., T, d) -> (..., T, d)."""
+    """Reference O(T^2) attention. Shapes: (..., T, d) -> (..., T, d).
+
+    Grouped-query attention: k/v may carry fewer heads than q on the -3
+    dim (H = G * Hkv); group g of G consecutive q heads reads kv head
+    ``h // G``, matching :func:`~beholder_tpu.ops.flash_attention.
+    flash_attention`'s layout. MHA is the G=1 case of the same path."""
     d = q.shape[-1]
-    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(jnp.float32(d))
+    if q.ndim >= 3:
+        if q.shape[-3] % k.shape[-3]:
+            raise ValueError(
+                f"GQA q heads must be a multiple of kv heads; got "
+                f"{q.shape} vs {k.shape}"
+            )
+        hkv = k.shape[-3]
+        g = q.shape[-3] // hkv  # 1 = ordinary MHA, same code path
+        qg = q.reshape(*q.shape[:-3], hkv, g, *q.shape[-2:])
+    else:
+        qg = q[..., None, :, :]  # rank-2 (T, d): one group of one "head"
+    scores = jnp.einsum("...gqd,...kd->...gqk", qg, k) / jnp.sqrt(
+        jnp.float32(d)
+    )
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool))
         scores = jnp.where(mask, scores, _NEG_INF)
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    return jnp.einsum("...qk,...kd->...qd", weights.astype(q.dtype), v)
+    out = jnp.einsum("...gqk,...kd->...gqd", weights.astype(q.dtype), v)
+    # merge (hkv, g) back into the head dim, keeping any leading dims the
+    # einsum broadcast (e.g. q with batch 1 against a batched k/v)
+    out = out.reshape(*out.shape[:-4], -1, *out.shape[-2:])
+    return out if q.ndim >= 3 else out[0]
 
 
 def _block_attend(q, k, v, q_offset, kv_offset, causal):

@@ -40,6 +40,13 @@ Design (see /opt/skills/guides/pallas_guide.md):
   multiple; padded kv columns are masked with -inf so they contribute
   nothing, padded q rows carry zero cotangents, and padded d columns
   contribute zeros to every dot product.
+- Grouped-query attention (GQA/MQA) is native: k/v may carry fewer
+  heads than q (H = G * Hkv). Flattening keeps heads innermost, so q's
+  flat index ``b`` reads kv flat index ``b // G`` — GQA costs ONE
+  integer divide in the k/v BlockSpec index maps and nothing else; the
+  kv tiles for a group's G q-heads are the same VMEM blocks. The
+  backward computes per-q-head dk/dv partials and reduces the G-sized
+  group axis in one fused XLA sum.
 - On non-TPU backends the kernels run in interpreter mode, so the same
   code path is exercised by the CPU-mesh tests.
 """
@@ -231,8 +238,10 @@ def _fwd_kernel(
     jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
 )
 def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
-    """(BH, T_pad, d_pad) inputs -> (o, lse) with the same padding."""
+    """(BH, T_pad, d_pad) q + (BHkv, T_pad, d_pad) k/v -> (o, lse) with
+    q's padding. GQA: q head ``b`` attends kv head ``b // group``."""
     bh, t_pad, d_pad = q.shape
+    group = bh // k.shape[0]
     block = _pick_block(t_pad)
     n_blk = t_pad // block
 
@@ -254,7 +263,7 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
         # stall DMA issue)
         qi_tab, kj_tab = _tri_tables(n_blk)
         q_map = lambda b, l, qt, kt: (b, qt[l], 0)
-        kv_map = lambda b, l, qt, kt: (b, kt[l], 0)
+        kv_map = lambda b, l, qt, kt: (b // group, kt[l], 0)
 
         def kernel(qt_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_ref, l_ref, acc_ref):
@@ -300,8 +309,8 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
         grid=(bh, n_blk, n_blk),
         in_specs=[
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
@@ -450,8 +459,12 @@ def _dkv_kernel(
     jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
 )
 def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
-    """Padded (BH, T_pad, d_pad) residuals + cotangent -> (dq, dk, dv)."""
+    """Padded (BH, T_pad, d_pad) residuals + cotangent -> (dq, dk, dv).
+
+    GQA (k/v lead BHkv = BH / group): dk/dv come back with q's BH lead —
+    one per-q-head partial per group member, reduced by the caller."""
     bh, t_pad, d_pad = q.shape
+    group = bh // k.shape[0]
     block = _pick_block(t_pad)
     n_blk = t_pad // block
 
@@ -469,9 +482,11 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         pltpu.VMEM((block, d_pad), jnp.float32),
         pltpu.VMEM((block, d_pad), jnp.float32),
     ]
+    # dk/dv carry q's BH lead (per-q-head partials under GQA; identical to
+    # the kv lead when group == 1)
     dkv_out_shape = [
-        jax.ShapeDtypeStruct(k.shape, k.dtype),
-        jax.ShapeDtypeStruct(v.shape, v.dtype),
+        jax.ShapeDtypeStruct((bh,) + k.shape[1:], k.dtype),
+        jax.ShapeDtypeStruct((bh,) + v.shape[1:], v.dtype),
     ]
 
     if causal:
@@ -480,7 +495,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         n_live = n_blk * (n_blk + 1) // 2
         qi_tab, kj_tab = _tri_tables(n_blk)
         q_map = lambda b, l, at, bt: (b, at[l], 0)
-        kv_map = lambda b, l, at, bt: (b, bt[l], 0)
+        kv_map = lambda b, l, at, bt: (b // group, bt[l], 0)
 
         def dq_kernel(at_ref, bt_ref, *refs):
             lin = pl.program_id(1)
@@ -506,9 +521,12 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
             interpret=interpret,
         )(qi_tab, kj_tab, q, k, v, do, lse_b, delta_b)
 
-        # dk/dv: kv tile resident -> kj-major enumeration, q innermost
+        # dk/dv: kv tile resident -> kj-major enumeration, q innermost.
+        # Inputs read kv head b // group; outputs write q head b (per-
+        # q-head partials, group-reduced by the caller).
         kj_tab2, qi_tab2 = _tri_tables_kv_major(n_blk)
-        kv_map2 = lambda b, l, kt, qt: (b, kt[l], 0)
+        kv_map2 = lambda b, l, kt, qt: (b // group, kt[l], 0)
+        dkv_map2 = lambda b, l, kt, qt: (b, kt[l], 0)
         q_map2 = lambda b, l, kt, qt: (b, qt[l], 0)
 
         def dkv_kernel(kt_ref, qt_ref, *refs):
@@ -528,7 +546,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
                     tile(q_map2), tile(kv_map2), tile(kv_map2),
                     tile(q_map2), rows(q_map2), rows(q_map2),
                 ],
-                out_specs=[tile(kv_map2), tile(kv_map2)],
+                out_specs=[tile(dkv_map2), tile(dkv_map2)],
                 scratch_shapes=dkv_scratch,
             ),
             out_shape=dkv_out_shape,
@@ -537,7 +555,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         return dq, dk, dv
 
     q_res = lambda b, i, j: (b, i, 0)        # follows the resident tile
-    kv_stream = lambda b, i, j: (b, j, 0)
+    kv_stream = lambda b, i, j: (b // group, j, 0)
 
     dq = pl.pallas_call(
         lambda *refs: _dq_kernel(
@@ -555,7 +573,8 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         interpret=interpret,
     )(q, k, v, do, lse_b, delta_b)
 
-    kv_res = lambda b, j, i: (b, j, 0)       # resident kv tile
+    kv_res = lambda b, j, i: (b // group, j, 0)   # resident kv tile
+    dkv_res = lambda b, j, i: (b, j, 0)           # per-q-head partial out
     q_stream = lambda b, j, i: (b, i, 0)
 
     dk, dv = pl.pallas_call(
@@ -568,7 +587,7 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
             tile(q_stream), tile(kv_res), tile(kv_res),
             tile(q_stream), rows(q_stream), rows(q_stream),
         ],
-        out_specs=[tile(kv_res), tile(kv_res)],
+        out_specs=[tile(dkv_res), tile(dkv_res)],
         out_shape=dkv_out_shape,
         scratch_shapes=dkv_scratch,
         interpret=interpret,
@@ -612,6 +631,7 @@ def _flash_fwd(q, k, v, causal):
 def _flash_bwd(causal, res, do):
     q, k, v, o, lse = res
     bh, t, d = q.shape
+    group = bh // k.shape[0]
     t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
     d_pad = -(-d // _LANES) * _LANES
     scale = float(1.0 / (d**0.5))
@@ -625,6 +645,13 @@ def _flash_bwd(causal, res, do):
         qp, kp, vp, op, lse_p, dop, causal=causal, interpret=_interpret(),
         t_real=t, scale=scale,
     )
+    if group > 1:
+        # per-q-head partials -> kv heads: flat q index = kv_index*G + g,
+        # so a C-order reshape exposes the group axis directly
+        dk = dk.reshape(k.shape[0], group, t_pad, d_pad)
+        dk = dk.astype(jnp.float32).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(v.shape[0], group, t_pad, d_pad)
+        dv = dv.astype(jnp.float32).sum(axis=1).astype(v.dtype)
     return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
 
 
@@ -638,8 +665,26 @@ def flash_attention(
 
     Matches :func:`beholder_tpu.ops.attention.full_attention` to float
     tolerance; never materializes the (T, T) score matrix in either pass.
+
+    Grouped-query attention: k/v may carry FEWER heads than q on the -3
+    dim (H = G * Hkv, MQA at Hkv=1); each group of G consecutive q heads
+    attends the same kv head. All other leading dims must match.
     """
     shape = q.shape
     t, d = shape[-2], shape[-1]
-    q3, k3, v3 = (a.reshape(-1, t, d) for a in (q, k, v))
+    if k.shape != q.shape:
+        if (
+            q.ndim < 3
+            or k.shape[:-3] != q.shape[:-3]
+            or k.shape[-2:] != q.shape[-2:]
+            or q.shape[-3] % k.shape[-3]
+        ):
+            raise ValueError(
+                f"GQA shapes must differ only in heads (-3 dim), with "
+                f"q heads a multiple of kv heads; got {q.shape} vs {k.shape}"
+            )
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    q3 = q.reshape(-1, t, d)
+    k3, v3 = (a.reshape(-1, t, d) for a in (k, v))
     return _flash(q3, k3, v3, causal).reshape(shape)

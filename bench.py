@@ -12,6 +12,13 @@ metric "N/A"), so there is no reference value to normalize against;
 aggregation throughput on the available accelerator.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+The shared-host environment drifts between rounds (a 2-3x swing in both
+CPU and accelerator throughput has been measured with zero code changes
+— see BENCH_NOTES.md for the controlled cross-round experiment), so
+cross-round comparisons should use the reported RATIOS
+(mfu_vs_measured_matmul, speedup_vs_xla, native_speedup), not absolute
+figures.
 """
 
 from __future__ import annotations

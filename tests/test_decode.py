@@ -270,3 +270,45 @@ def test_2d_serving_dp_tp_cache_and_numerics(sharded_setup):
         np.asarray(jnp.stack(ref_preds)),
         rtol=2e-2, atol=5e-3,
     )
+
+
+# ---------------------------------------------------------------------------
+# grouped-query attention serving
+# ---------------------------------------------------------------------------
+
+
+def test_gqa_cache_is_group_smaller_and_decode_matches_full():
+    """kv_heads=1 (MQA) shrinks the cache by the group factor while the
+    incremental decode still reproduces the full causal forward."""
+    model = TelemetrySequenceModel(dim=32, heads=4, layers=2, kv_heads=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(3), 24, model=model)
+    rng = np.random.default_rng(3)
+    t = 24
+    prog = jnp.asarray(np.cumsum(2.0 + rng.normal(0, 0.3, (2, t + 1)), axis=-1))
+    stats = jnp.full((2, t + 1), TelemetryStatusEntry.CONVERTING)
+    feats, _ = stream_features(prog, stats)
+
+    full = model.apply(state.params, feats)
+    split = 10
+    _, cache = prefill(model, state.params, feats[:, :split], max_len=t)
+    # one kv head instead of four: cache holds (B, 1, max_len, Dh)
+    assert cache.keys[0].shape == (2, 1, t, 8)
+    preds = []
+    for i in range(split, t):
+        pred, cache = decode_step(model, state.params, cache, feats[:, i])
+        preds.append(pred)
+    got = jnp.stack(preds, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full[:, split:]), rtol=2e-3, atol=2e-4
+    )
+
+
+def test_gqa_forecast_eta_runs_end_to_end():
+    model = TelemetrySequenceModel(dim=32, heads=4, layers=1, kv_heads=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(4), 16, model=model)
+    rng = np.random.default_rng(4)
+    prog = jnp.asarray(np.cumsum(3.0 + rng.normal(0, 0.2, (2, 17)), axis=-1))
+    stats = jnp.full((2, 17), TelemetryStatusEntry.CONVERTING)
+    eta, reached = forecast_eta(model, state.params, prog, stats, horizon=30)
+    assert eta.shape == (2,) and reached.shape == (2,)
+    assert np.isfinite(np.asarray(eta)).all()
