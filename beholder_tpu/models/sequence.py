@@ -94,6 +94,10 @@ class Block(nn.Module):
     #: lever. With megatron tp, kv_heads % tp must be 0 so each shard
     #: holds whole kv heads.
     kv_heads: int | None = None
+    #: sliding-window attention: each position attends only the previous
+    #: ``window`` positions (flash/full backends; the packed banded
+    #: kernel grid makes cost scale with T * window)
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
@@ -123,12 +127,24 @@ class Block(nn.Module):
         )
         if cache is not None:
             k_cache, v_cache, index = cache
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
-            )
+            if getattr(index, "ndim", 0) == 1:
+                # per-sequence positions (continuous batching: each slot
+                # sits at its own length) — scatter one column per batch
+                # row; t must be 1 on this path
+                rows = jnp.arange(b)
+                k_cache = k_cache.at[rows, :, index, :].set(
+                    k[:, :, 0, :].astype(k_cache.dtype)
+                )
+                v_cache = v_cache.at[rows, :, index, :].set(
+                    v[:, :, 0, :].astype(v_cache.dtype)
+                )
+            else:
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
+                )
             # Same dtype mix as ops.attention.full_attention (the training
             # forward): score matmul in the cache dtype (bf16 on the MXU),
             # f32 softmax, weights cast back before the PV matmul — so
@@ -142,7 +158,20 @@ class Block(nn.Module):
                 "bhgqd,bhkd->bhgqk", qg, k_cache
             ) / jnp.sqrt(jnp.float32(dh))
             positions = jnp.arange(k_cache.shape[2])
-            scores = jnp.where(positions <= index, scores, -1e30)
+            if getattr(index, "ndim", 0) == 1:
+                live = positions[None, :] <= index[:, None]     # (B, L)
+                if self.window is not None:
+                    live = live & (
+                        positions[None, :] > index[:, None] - self.window
+                    )
+                live = live[:, None, None, None, :]
+            else:
+                live = positions <= index
+                if self.window is not None:
+                    # decode position ``index`` sees the previous
+                    # ``window`` cache slots, matching the training band
+                    live = live & (positions > index - self.window)
+            scores = jnp.where(live, scores, -1e30)
             weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
             att = jnp.einsum(
                 "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), v_cache
@@ -151,6 +180,13 @@ class Block(nn.Module):
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")
+            if self.window is not None and self.attention not in (
+                "flash", "full"
+            ):
+                raise ValueError(
+                    f"window is supported by the flash/full backends, "
+                    f"not {self.attention!r}"
+                )
             kv_out = (k, v)  # cache k/v keep their hkv heads
             if self.attention in ("ring", "ulysses") and hkv != h:
                 # the sp collectives (ppermute / all-to-all) move k/v by
@@ -164,9 +200,9 @@ class Block(nn.Module):
             elif self.attention == "ulysses":
                 att = ulysses_attention(q, k, v, self.mesh, causal=True)
             elif self.attention == "flash":
-                att = flash_attention(q, k, v, causal=True)
+                att = flash_attention(q, k, v, causal=True, window=self.window)
             else:
-                att = full_attention(q, k, v, causal=True)
+                att = full_attention(q, k, v, causal=True, window=self.window)
         att = att.transpose(0, 2, 1, 3).reshape(b, t, d)
         x = x + nn.Dense(d, name="proj", dtype=jnp.bfloat16)(att).astype(x.dtype)
 
@@ -214,6 +250,8 @@ class TelemetrySequenceModel(nn.Module):
     #: grouped-query attention (GQA; 1 = MQA): k/v heads per block. The
     #: KV cache shrinks by heads/kv_heads (see models/decode.py)
     kv_heads: int | None = None
+    #: sliding-window attention span (flash/full backends)
+    window: int | None = None
 
     @nn.compact
     def __call__(self, feats: jax.Array, cache=None, return_kv: bool = False):
@@ -242,6 +280,7 @@ class TelemetrySequenceModel(nn.Module):
                 moe_topk=self.moe_topk,
                 seq_shard=self.seq_shard,
                 kv_heads=self.kv_heads,
+                window=self.window,
                 name=f"block_{i}",
             )
             if cache is not None:

@@ -35,15 +35,30 @@ _NEG_INF = -1e30
 
 
 def full_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    window: int | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Reference O(T^2) attention. Shapes: (..., T, d) -> (..., T, d).
 
     Grouped-query attention: k/v may carry fewer heads than q on the -3
     dim (H = G * Hkv); group g of G consecutive q heads reads kv head
     ``h // G``, matching :func:`~beholder_tpu.ops.flash_attention.
-    flash_attention`'s layout. MHA is the G=1 case of the same path."""
+    flash_attention`'s layout. MHA is the G=1 case of the same path.
+
+    ``window`` (with ``causal``) keeps only the previous ``window``
+    positions per row; ``segment_ids`` (batch-shaped ``q.shape[:-3] +
+    (T,)``) masks cross-segment attention — both matching
+    :func:`~beholder_tpu.ops.flash_attention.flash_attention`."""
     d = q.shape[-1]
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if q.ndim >= 3:
         if q.shape[-3] % k.shape[-3]:
             raise ValueError(
@@ -58,10 +73,18 @@ def full_attention(
     scores = jnp.einsum("...gqd,...kd->...gqk", qg, k) / jnp.sqrt(
         jnp.float32(d)
     )
+    tq, tk = scores.shape[-2], scores.shape[-1]
+    rows = jnp.arange(tq)[:, None]
+    cols = jnp.arange(tk)[None, :]
     if causal:
-        tq, tk = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool))
-        scores = jnp.where(mask, scores, _NEG_INF)
+        scores = jnp.where(rows >= cols, scores, _NEG_INF)
+    if window is not None:
+        scores = jnp.where(rows - cols < window, scores, _NEG_INF)
+    if segment_ids is not None:
+        seg_mask = segment_ids[..., :, None] == segment_ids[..., None, :]
+        scores = jnp.where(
+            seg_mask[..., None, None, :, :], scores, _NEG_INF
+        )
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("...gqk,...kd->...gqd", weights.astype(q.dtype), v)
     # merge (hkv, g) back into the head dim, keeping any leading dims the

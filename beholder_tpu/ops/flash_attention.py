@@ -47,6 +47,17 @@ Design (see /opt/skills/guides/pallas_guide.md):
   kv tiles for a group's G q-heads are the same VMEM blocks. The
   backward computes per-q-head dk/dv partials and reduces the G-sized
   group axis in one fused XLA sum.
+- Sliding-window attention generalizes the packed triangular grid to a
+  packed BANDED grid: the same scalar-prefetched tables enumerate only
+  in-band (qi, kj) pairs (with first/last flags driving state init and
+  output write-out), so forward AND backward cost scales with
+  T * window instead of T^2.
+- Segment ids (packed-sequence training) mask cross-segment attention
+  inside the kernels: the q-side ids ride the lane-broadcast lse
+  layout, the kv-side ids a sublane-broadcast row layout, so the
+  (block, block) segment-equality mask is one broadcast compare with no
+  in-kernel transpose. Blocks can then be fully masked at runtime, so
+  the softmax zeroes masked probabilities explicitly.
 - On non-TPU backends the kernels run in interpreter mode, so the same
   code path is exercised by the CPU-mesh tests.
 """
@@ -66,9 +77,20 @@ _MIN_BLOCK = 128   # T padding granule; smallest tile
 _MAX_BLOCK = 1024  # preferred q/kv block rows when T allows
 
 
-def _pick_block(t_pad: int) -> int:
-    """Largest power-of-two block in [128, 512] dividing t_pad."""
+def _pick_block(t_pad: int, window: int | None = None) -> int:
+    """Largest power-of-two block in [128, 1024] dividing t_pad — capped
+    near ``window`` when sliding-window attention is on. With block >>
+    window every live block sits on the band edge and pays the full
+    (block, block) mask compute; with block ~ window each q row touches
+    ~2 small blocks and the mask shrinks quadratically, trading into
+    fixed per-step grid overhead instead. Measured on v5e at T=16k the
+    two effects balance (~1.4x over full causal either way); the cap
+    keeps the live-step count — and VMEM footprint — proportional to
+    the window rather than to T."""
     b = _MAX_BLOCK
+    if window is not None:
+        cap = max(_MIN_BLOCK, 1 << (window - 1).bit_length())
+        b = min(b, cap)
     while b > _MIN_BLOCK and t_pad % b:
         b //= 2
     return b
@@ -78,13 +100,22 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _masked_dispatch(step, *, causal, qi, kj, n_blk, padded):
+def _masked_dispatch(step, *, causal, qi, kj, n_blk, padded, window=None,
+                     block=None, has_seg=False):
     """Run ``step(masked)`` with masking only where it can bite: the causal
-    diagonal block and (when T was padded) the last kv block. Interior
-    blocks skip the iota/compare/select entirely. Padded q ROWS never need
-    a mask in the backward kernels: their lse is +BIG so the recomputed
+    diagonal block, (when T was padded) the last kv block, and (under a
+    sliding window) the band's trailing-edge blocks. Interior blocks skip
+    the iota/compare/select entirely. Segment ids are runtime data, so
+    with ``has_seg`` every block masks. Padded q ROWS never need a mask in
+    the backward kernels: their lse is +BIG so the recomputed
     probabilities underflow to exactly 0."""
+    if has_seg:
+        step(True)
+        return
     needs_mask = (qi == kj) if causal else False
+    if window is not None:
+        # fully-live needs max(row-col) = (qi-kj+1)*block - 1 < window
+        needs_mask = needs_mask | ((qi - kj + 1) * block - 1 >= window)
     if padded:
         needs_mask = needs_mask | (kj == n_blk - 1)
     if needs_mask is False:
@@ -94,31 +125,67 @@ def _masked_dispatch(step, *, causal, qi, kj, n_blk, padded):
         pl.when(jnp.logical_not(needs_mask))(lambda: step(False))
 
 
-def _tri_tables(n_blk):
-    """Host-side (qi, kj) lookup tables for the packed causal grid.
+def _first_kj(qi: int, block: int, window: int | None) -> int:
+    """First kv block holding any live column for q tile ``qi`` under a
+    causal (+ optional sliding-window) mask. Row r attends cols in
+    [r-window+1, r]; the tile's first row qi*block reaches back furthest."""
+    if window is None:
+        return 0
+    return max(0, (qi * block - window + 1) // block)
 
-    Enumerates (0,0),(1,0),(1,1),(2,0),... so the causal grid contains ONLY
-    live blocks — a rectangular grid would spend ~40% of its steps on fully
-    masked (qi < kj) pairs that still pay grid/DMA-sync overhead. The tables
-    ride scalar prefetch (SMEM): index maps do one table load per step
-    instead of recomputing a triangular decode on the scalar core.
+
+def _last_qi(kj: int, n_blk: int, block: int, window: int | None) -> int:
+    """Last q tile with any live row for kv block ``kj`` (dual of
+    :func:`_first_kj`): (qi-kj-1)*block + 1 <= window-1 must hold."""
+    if window is None:
+        return n_blk - 1
+    return min(n_blk - 1, kj + 1 + (window - 2) // block) if window > 1 else kj
+
+
+def _band_tables(n_blk, block, window):
+    """Host-side lookup tables for the packed causal/banded grid, qi-major.
+
+    Enumerates only LIVE (qi, kj) block pairs — kj in
+    [_first_kj(qi), qi] — so fully masked pairs never iterate: a
+    rectangular grid would spend ~40% (causal) to ~95% (short sliding
+    window at long T) of its steps on dead pairs that still pay
+    grid/DMA-sync overhead. Every enumerated block holds at least one
+    live (row, col) pair, which the online softmax requires (a fully
+    masked block would turn exp(s - m) into ones). The tables ride scalar
+    prefetch (SMEM): index maps do one table load per step instead of
+    recomputing a banded decode on the scalar core.
+
+    Returns (qi, kj, first, last): per-step block coordinates plus flags
+    marking the first/last kv step of each q tile's run — the kernels
+    init their online-softmax state on ``first`` and write the tile's
+    output on ``last`` (with a full causal band these degenerate to the
+    classic ``kj == 0`` / ``kj == qi`` conditions).
     """
-    import numpy as np
+    qi, kj, first, last = [], [], [], []
+    for i in range(n_blk):
+        lo = _first_kj(i, block, window)
+        for j in range(lo, i + 1):
+            qi.append(i)
+            kj.append(j)
+            first.append(1 if j == lo else 0)
+            last.append(1 if j == i else 0)
+    return tuple(jnp.asarray(t, jnp.int32) for t in (qi, kj, first, last))
 
-    qi = np.repeat(np.arange(n_blk), np.arange(1, n_blk + 1))
-    kj = np.concatenate([np.arange(i + 1) for i in range(n_blk)])
-    return jnp.asarray(qi, jnp.int32), jnp.asarray(kj, jnp.int32)
 
-
-def _tri_tables_kv_major(n_blk):
-    """(kj, qi) tables for the dk/dv kernel's packed grid: kv-tile-resident,
-    so the enumeration is kj-major with qi running kj..n_blk-1 —
-    (0,0),(0,1),...,(0,n-1),(1,1),... Only live (qi >= kj) pairs appear."""
-    import numpy as np
-
-    kj = np.repeat(np.arange(n_blk), np.arange(n_blk, 0, -1))
-    qi = np.concatenate([np.arange(j, n_blk) for j in range(n_blk)])
-    return jnp.asarray(kj, jnp.int32), jnp.asarray(qi, jnp.int32)
+def _band_tables_kv_major(n_blk, block, window):
+    """(kj, qi, first, last) tables for the dk/dv kernel's packed grid:
+    kv-tile-resident, so the enumeration is kj-major with qi running
+    kj.._last_qi(kj). Only live pairs appear; ``first``/``last`` flag the
+    first/last q step of each kv tile's run."""
+    kj, qi, first, last = [], [], [], []
+    for j in range(n_blk):
+        hi = _last_qi(j, n_blk, block, window)
+        for i in range(j, hi + 1):
+            kj.append(j)
+            qi.append(i)
+            first.append(1 if i == j else 0)
+            last.append(1 if i == hi else 0)
+    return tuple(jnp.asarray(t, jnp.int32) for t in (kj, qi, first, last))
 
 
 # ---------------------------------------------------------------------------
@@ -134,8 +201,9 @@ _SUB = 1024
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    qi_kj, *, t_real, t_pad, causal, scale, block,
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+    m_ref, l_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block,
+    window,
 ):
     """One (block, d) q tile x one streamed (block, d) kv tile.
 
@@ -143,23 +211,28 @@ def _fwd_kernel(
     overlap each chunk's softmax (VPU) with the next chunk's score
     matmul (MXU); at d=128 flash attention is VPU-bound otherwise.
     Masking is only computed where it can bite: the causal diagonal
-    block and (when T was padded) the last kv block — interior blocks
-    skip the iota/compare/select entirely.
+    block, the sliding-window band edge, and (when T was padded) the
+    last kv block — interior blocks skip the iota/compare/select
+    entirely. Segment ids (``qseg_ref``/``kseg_ref`` non-None) mask
+    every block, plus a p-zeroing guard because a block can then be
+    fully masked at runtime (exp(s - m) would otherwise turn into ones).
 
-    Causal runs on a PACKED triangular grid (bh, n_live): (qi, kj) come
-    from scalar-prefetched lookup tables so fully-masked pairs never
-    iterate. Non-causal keeps the rectangular (bh, nq, nkv) grid.
+    Causal runs on a PACKED banded grid (bh, n_live): (qi, kj, first,
+    last) come from scalar-prefetched lookup tables so fully-masked
+    pairs never iterate. Non-causal keeps the rectangular (bh, nq, nkv)
+    grid.
     """
     n_blk = t_pad // block
+    has_seg = qseg_ref is not None
     if causal:
-        qi, kj = qi_kj            # read from the scalar-prefetch tables
-        last_kv = qi              # the diagonal block ends row qi
+        qi, kj, is_first, is_last = band  # scalar-prefetch table reads
     else:
         qi = pl.program_id(1)
         kj = pl.program_id(2)
-        last_kv = pl.num_programs(2) - 1
+        is_first = kj == 0
+        is_last = kj == pl.num_programs(2) - 1
 
-    @pl.when(kj == 0)
+    @pl.when(is_first)
     def _init():
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -189,6 +262,12 @@ def _fwd_kernel(
                 valid = cols < t_real
                 if causal:
                     valid = valid & (rows >= cols)
+                if window is not None:
+                    valid = valid & (rows - cols < window)
+                if has_seg:
+                    qseg = qseg_ref[0][:, :1]                  # (bq, 1)
+                    kseg = kseg_ref[0][:1, j2 * sub:(j2 + 1) * sub]
+                    valid = valid & (qseg == kseg)             # (bq, sub)
                 s = jnp.where(valid, s, _NEG_INF)
             return s
 
@@ -202,6 +281,10 @@ def _fwd_kernel(
             m_prev = m_ref[:, :1]          # (bq, 1); lanes hold copies
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)         # (bq, sub) f32
+            if has_seg:
+                # a fully-masked block leaves m_new at -inf and p at
+                # exp(0)=1; zero the masked entries explicitly
+                p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
             alpha = jnp.exp(m_prev - m_new)
             l_ref[:] = jnp.broadcast_to(
                 l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
@@ -214,14 +297,14 @@ def _fwd_kernel(
             m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
             s = s_next
 
-    # the packed causal grid contains only live (qi >= kj) pairs, so no
+    # the packed banded grid contains only live block pairs, so no
     # liveness guard is needed
     _masked_dispatch(
         _chunks, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
-        padded=t_pad != t_real,
+        padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
     )
 
-    @pl.when(kj == last_kv)
+    @pl.when(is_last)
     def _finalize():
         l = l_ref[:, :1]
         m = m_ref[:, :1]
@@ -234,16 +317,60 @@ def _fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+_SEG_SUB = 8  # sublane-broadcast rows for the kv-side segment layout
+
+
+def _seg_operands(seg, t_pad):
+    """Kernel-friendly segment layouts from the (B, T) batch-lead ids:
+    the q side lane-broadcast (B, T_pad, LANES) so a (block, 1) column
+    reads straight off the sublane dim (the lse trick), the kv side
+    sublane-broadcast (B, 8, T_pad) so a (1, block) ROW vector reads
+    without any in-kernel transpose. Both stay BATCH-lead — segments
+    don't vary by head, so the BlockSpec index maps divide the flat
+    (B*H) grid index by the head count instead of materializing H
+    copies in HBM. Padded positions get segment -1 (matches nothing;
+    padded columns are already masked by ``cols < t_real``)."""
+    t = seg.shape[-1]
+    s = jnp.pad(seg.astype(jnp.int32), ((0, 0), (0, t_pad - t)),
+                constant_values=-1)
+    q_op = jnp.broadcast_to(s[:, :, None], (*s.shape, _LANES))
+    k_op = jnp.broadcast_to(s[:, None, :], (s.shape[0], _SEG_SUB, s.shape[1]))
+    return q_op, k_op
+
+
+def _seg_specs(has_seg, block, qseg_map, kseg_map):
+    """The two segment-operand BlockSpecs (q-side lane-broadcast column,
+    kv-side sublane-broadcast row), or [] when segments are off."""
+    if not has_seg:
+        return []
+    return [
+        pl.BlockSpec((1, block, _LANES), qseg_map),
+        pl.BlockSpec((1, _SEG_SUB, block), kseg_map),
+    ]
+
+
 @functools.partial(
-    jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
+    jax.jit,
+    static_argnames=("causal", "interpret", "t_real", "scale", "window"),
 )
-def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
+def _flash_fwd_padded(
+    q, k, v, qseg=None, kseg=None, *, causal, interpret, t_real, scale,
+    window=None,
+):
     """(BH, T_pad, d_pad) q + (BHkv, T_pad, d_pad) k/v -> (o, lse) with
-    q's padding. GQA: q head ``b`` attends kv head ``b // group``."""
+    q's padding. GQA: q head ``b`` attends kv head ``b // group``.
+    ``qseg``/``kseg`` are the pre-broadcast segment operands from
+    :func:`_seg_operands`; ``window`` is the causal sliding-window span.
+    """
     bh, t_pad, d_pad = q.shape
     group = bh // k.shape[0]
-    block = _pick_block(t_pad)
+    block = _pick_block(t_pad, window)
     n_blk = t_pad // block
+    has_seg = qseg is not None
+    seg_in = [qseg, kseg] if has_seg else []
+    # segment operands are BATCH-lead (see _seg_operands): divide the
+    # flat (B*H) grid index down to the batch
+    seg_div = bh // qseg.shape[0] if has_seg else 1
 
     scratch = [
         pltpu.VMEM((block, _LANES), jnp.float32),  # m
@@ -257,33 +384,44 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
     ]
 
     if causal:
-        # packed triangular grid: one step per LIVE (qi, kj) block pair,
+        # packed banded grid: one step per LIVE (qi, kj) block pair,
         # driven by scalar-prefetched lookup tables (index maps do one SMEM
         # load per step; a computed decode would run on the scalar core and
         # stall DMA issue)
-        qi_tab, kj_tab = _tri_tables(n_blk)
-        q_map = lambda b, l, qt, kt: (b, qt[l], 0)
-        kv_map = lambda b, l, qt, kt: (b // group, kt[l], 0)
+        qi_tab, kj_tab, first_tab, last_tab = _band_tables(
+            n_blk, block, window
+        )
+        q_map = lambda b, l, *tabs: (b, tabs[0][l], 0)
+        kv_map = lambda b, l, *tabs: (b // group, tabs[1][l], 0)
+        seg_specs = _seg_specs(
+            has_seg, block,
+            lambda b, l, *tabs: (b // seg_div, tabs[0][l], 0),
+            lambda b, l, *tabs: (b // seg_div, 0, tabs[1][l]),
+        )
 
-        def kernel(qt_ref, kt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_ref, l_ref, acc_ref):
+        def kernel(qt_ref, kt_ref, ft_ref, lt_ref, q_ref, k_ref, v_ref,
+                   *rest):
+            qseg_ref, kseg_ref = (rest[0], rest[1]) if has_seg else (None, None)
+            o_ref, lse_ref, m_ref, l_ref, acc_ref = rest[2 if has_seg else 0:]
             lin = pl.program_id(1)
             _fwd_kernel(
-                q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                (qt_ref[lin], kt_ref[lin]),
+                q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref,
+                (qt_ref[lin], kt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block,
+                block=block, window=window,
             )
 
         o, lse = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(bh, n_blk * (n_blk + 1) // 2),
+                num_scalar_prefetch=4,
+                grid=(bh, qi_tab.shape[0]),
                 in_specs=[
                     pl.BlockSpec((1, block, d_pad), q_map),
                     pl.BlockSpec((1, block, d_pad), kv_map),
                     pl.BlockSpec((1, block, d_pad), kv_map),
+                    *seg_specs,
                 ],
                 out_specs=[
                     pl.BlockSpec((1, block, d_pad), q_map),
@@ -293,15 +431,23 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
             ),
             out_shape=out_shape,
             interpret=interpret,
-        )(qi_tab, kj_tab, q, k, v)
+        )(qi_tab, kj_tab, first_tab, last_tab, q, k, v, *seg_in)
         return o, lse[:, :, 0]
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref):
+    seg_specs = _seg_specs(
+        has_seg, block,
+        lambda b, i, j: (b // seg_div, i, 0),
+        lambda b, i, j: (b // seg_div, 0, j),
+    )
+
+    def kernel(q_ref, k_ref, v_ref, *rest):
+        qseg_ref, kseg_ref = (rest[0], rest[1]) if has_seg else (None, None)
+        o_ref, lse_ref, m_ref, l_ref, acc_ref = rest[2 if has_seg else 0:]
         _fwd_kernel(
-            q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-            None,
+            q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+            m_ref, l_ref, acc_ref, None,
             t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-            block=block,
+            block=block, window=window,
         )
 
     o, lse = pl.pallas_call(
@@ -311,6 +457,7 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b // group, j, 0)),
+            *seg_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, block, d_pad), lambda b, i, j: (b, i, 0)),
@@ -319,7 +466,7 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
         out_shape=out_shape,
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *seg_in)
     return o, lse[:, :, 0]
 
 
@@ -329,19 +476,20 @@ def _flash_fwd_padded(q, k, v, *, causal, interpret, t_real, scale):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    qi_kj, *, t_real, t_pad, causal, scale, block,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+    dq_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block, window,
 ):
     n_blk = t_pad // block
+    has_seg = qseg_ref is not None
     if causal:
-        qi, kj = qi_kj            # packed triangular grid (see forward)
-        last_kv = qi
+        qi, kj, is_first, is_last = band  # packed banded grid (see forward)
     else:
         qi = pl.program_id(1)
         kj = pl.program_id(2)
-        last_kv = pl.num_programs(2) - 1
+        is_first = kj == 0
+        is_last = kj == pl.num_programs(2) - 1
 
-    @pl.when(kj == 0)
+    @pl.when(is_first)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -362,10 +510,18 @@ def _dq_kernel(
             valid = cols < t_real
             if causal:
                 valid = valid & (rows >= cols)
+            if window is not None:
+                valid = valid & (rows - cols < window)
+            if has_seg:
+                valid = valid & (qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
             s = jnp.where(valid, s, _NEG_INF)
         # p: exact probabilities recomputed from the saved logsumexp
         # (padded q rows carry lse=+BIG so p underflows to exactly 0)
         p = jnp.exp(s - lse_ref[0][:, :1])             # (bq, bk) f32
+        if has_seg:
+            # rows with NO live columns anywhere carry lse=-BIG, making
+            # exp(s - lse) ones on their masked entries; zero explicitly
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -378,10 +534,10 @@ def _dq_kernel(
 
     _masked_dispatch(
         _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
-        padded=t_pad != t_real,
+        padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
     )
 
-    @pl.when(kj == last_kv)
+    @pl.when(is_last)
     def _finalize():
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
@@ -392,20 +548,21 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc, kj_qi, *, t_real, t_pad, causal, scale, block,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, band, *, t_real, t_pad, causal, scale,
+    block, window,
 ):
     n_blk = t_pad // block
+    has_seg = qseg_ref is not None
     if causal:
-        kj, qi = kj_qi            # packed upper-triangle grid, q innermost
-        first_q = kj              # row kj's first contributing q block
+        kj, qi, is_first, is_last = band  # packed banded grid, q innermost
     else:
         kj = pl.program_id(1)
         qi = pl.program_id(2)
-        first_q = 0
-    n_q = n_blk
+        is_first = qi == 0
+        is_last = qi == pl.num_programs(2) - 1
 
-    @pl.when(qi == first_q)
+    @pl.when(is_first)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -428,8 +585,14 @@ def _dkv_kernel(
             valid = cols < t_real
             if causal:
                 valid = valid & (rows >= cols)
+            if window is not None:
+                valid = valid & (rows - cols < window)
+            if has_seg:
+                valid = valid & (qseg_ref[0][:, :1] == kseg_ref[0][:1, :])
             s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk) f32
+        if has_seg:
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)    # see _dq_kernel
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -446,27 +609,35 @@ def _dkv_kernel(
 
     _masked_dispatch(
         _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
-        padded=t_pad != t_real,
+        padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
     )
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(is_last)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "interpret", "t_real", "scale")
+    jax.jit,
+    static_argnames=("causal", "interpret", "t_real", "scale", "window"),
 )
-def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
+def _flash_bwd_padded(
+    q, k, v, o, lse, do, qseg=None, kseg=None, *, causal, interpret, t_real,
+    scale, window=None,
+):
     """Padded (BH, T_pad, d_pad) residuals + cotangent -> (dq, dk, dv).
 
     GQA (k/v lead BHkv = BH / group): dk/dv come back with q's BH lead —
-    one per-q-head partial per group member, reduced by the caller."""
+    one per-q-head partial per group member, reduced by the caller.
+    ``qseg``/``kseg`` are :func:`_seg_operands` layouts; ``window`` is the
+    causal sliding-window span (the packed banded grids then skip all
+    out-of-band blocks in BOTH backward kernels)."""
     bh, t_pad, d_pad = q.shape
     group = bh // k.shape[0]
-    block = _pick_block(t_pad)
+    block = _pick_block(t_pad, window)
     n_blk = t_pad // block
+    has_seg = qseg is not None
 
     # delta_i = sum_d do_i * o_i — one cheap fused XLA pass. Both lse and
     # delta take the lane-broadcast (BH, T_pad, 128) layout so the kernels
@@ -488,70 +659,99 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
         jax.ShapeDtypeStruct((bh,) + k.shape[1:], k.dtype),
         jax.ShapeDtypeStruct((bh,) + v.shape[1:], v.dtype),
     ]
+    seg_in = [qseg, kseg] if has_seg else []
+    # segment operands are BATCH-lead (see _seg_operands)
+    seg_div = bh // qseg.shape[0] if has_seg else 1
+
+    def seg_specs(qseg_map, kseg_map):
+        return _seg_specs(has_seg, block, qseg_map, kseg_map)
+
+    def unpack(refs):
+        """(inputs..., [qseg, kseg], outputs..., scratch...) -> canonical
+        kernel arg order with None seg refs when segments are off."""
+        ins, rest = refs[:6], refs[6:]
+        segs = (rest[0], rest[1]) if has_seg else (None, None)
+        tail = rest[2:] if has_seg else rest
+        return (*ins, *segs, *tail)
 
     if causal:
-        # packed triangular grids (same trick as the forward): one grid
-        # step per LIVE (qi, kj) pair, (qi, kj) scalar-prefetched
-        n_live = n_blk * (n_blk + 1) // 2
-        qi_tab, kj_tab = _tri_tables(n_blk)
-        q_map = lambda b, l, at, bt: (b, at[l], 0)
-        kv_map = lambda b, l, at, bt: (b // group, bt[l], 0)
+        # packed banded grids (same trick as the forward): one grid step
+        # per LIVE (qi, kj) pair, coordinates + first/last scalar-prefetched
+        qi_tab, kj_tab, first_tab, last_tab = _band_tables(
+            n_blk, block, window
+        )
+        q_map = lambda b, l, *t: (b, t[0][l], 0)
+        kv_map = lambda b, l, *t: (b // group, t[1][l], 0)
 
-        def dq_kernel(at_ref, bt_ref, *refs):
+        def dq_kernel(at_ref, bt_ref, ft_ref, lt_ref, *refs):
             lin = pl.program_id(1)
             _dq_kernel(
-                *refs, (at_ref[lin], bt_ref[lin]),
+                *unpack(refs),
+                (at_ref[lin], bt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block,
+                block=block, window=window,
             )
 
         dq = pl.pallas_call(
             dq_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(bh, n_live),
+                num_scalar_prefetch=4,
+                grid=(bh, qi_tab.shape[0]),
                 in_specs=[
                     tile(q_map), tile(kv_map), tile(kv_map),
                     tile(q_map), rows(q_map), rows(q_map),
+                    *seg_specs(
+                        lambda b, l, *t: (b // seg_div, t[0][l], 0),
+                        lambda b, l, *t: (b // seg_div, 0, t[1][l]),
+                    ),
                 ],
                 out_specs=tile(q_map),
                 scratch_shapes=dq_scratch,
             ),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
-        )(qi_tab, kj_tab, q, k, v, do, lse_b, delta_b)
+        )(qi_tab, kj_tab, first_tab, last_tab, q, k, v, do, lse_b, delta_b,
+          *seg_in)
 
         # dk/dv: kv tile resident -> kj-major enumeration, q innermost.
         # Inputs read kv head b // group; outputs write q head b (per-
         # q-head partials, group-reduced by the caller).
-        kj_tab2, qi_tab2 = _tri_tables_kv_major(n_blk)
-        kv_map2 = lambda b, l, kt, qt: (b // group, kt[l], 0)
-        dkv_map2 = lambda b, l, kt, qt: (b, kt[l], 0)
-        q_map2 = lambda b, l, kt, qt: (b, qt[l], 0)
+        kj_tab2, qi_tab2, first_tab2, last_tab2 = _band_tables_kv_major(
+            n_blk, block, window
+        )
+        kv_map2 = lambda b, l, *t: (b // group, t[0][l], 0)
+        dkv_map2 = lambda b, l, *t: (b, t[0][l], 0)
+        q_map2 = lambda b, l, *t: (b, t[1][l], 0)
 
-        def dkv_kernel(kt_ref, qt_ref, *refs):
+        def dkv_kernel(kt_ref, qt_ref, ft_ref, lt_ref, *refs):
             lin = pl.program_id(1)
             _dkv_kernel(
-                *refs, (kt_ref[lin], qt_ref[lin]),
+                *unpack(refs),
+                (kt_ref[lin], qt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block,
+                block=block, window=window,
             )
 
         dk, dv = pl.pallas_call(
             dkv_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(bh, n_live),
+                num_scalar_prefetch=4,
+                grid=(bh, kj_tab2.shape[0]),
                 in_specs=[
                     tile(q_map2), tile(kv_map2), tile(kv_map2),
                     tile(q_map2), rows(q_map2), rows(q_map2),
+                    *seg_specs(
+                        lambda b, l, *t: (b // seg_div, t[1][l], 0),
+                        lambda b, l, *t: (b // seg_div, 0, t[0][l]),
+                    ),
                 ],
                 out_specs=[tile(dkv_map2), tile(dkv_map2)],
                 scratch_shapes=dkv_scratch,
             ),
             out_shape=dkv_out_shape,
             interpret=interpret,
-        )(kj_tab2, qi_tab2, q, k, v, do, lse_b, delta_b)
+        )(kj_tab2, qi_tab2, first_tab2, last_tab2, q, k, v, do, lse_b,
+          delta_b, *seg_in)
         return dq, dk, dv
 
     q_res = lambda b, i, j: (b, i, 0)        # follows the resident tile
@@ -559,19 +759,23 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
 
     dq = pl.pallas_call(
         lambda *refs: _dq_kernel(
-            *refs, None, t_real=t_real, t_pad=t_pad, causal=causal,
-            scale=scale, block=block,
+            *unpack(refs), None, t_real=t_real, t_pad=t_pad,
+            causal=causal, scale=scale, block=block, window=window,
         ),
         grid=(bh, n_blk, n_blk),
         in_specs=[
             tile(q_res), tile(kv_stream), tile(kv_stream),
             tile(q_res), rows(q_res), rows(q_res),
+            *seg_specs(
+                lambda b, i, j: (b // seg_div, i, 0),
+                lambda b, i, j: (b // seg_div, 0, j),
+            ),
         ],
         out_specs=tile(q_res),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=dq_scratch,
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(q, k, v, do, lse_b, delta_b, *seg_in)
 
     kv_res = lambda b, j, i: (b // group, j, 0)   # resident kv tile
     dkv_res = lambda b, j, i: (b, j, 0)           # per-q-head partial out
@@ -579,19 +783,23 @@ def _flash_bwd_padded(q, k, v, o, lse, do, *, causal, interpret, t_real, scale):
 
     dk, dv = pl.pallas_call(
         lambda *refs: _dkv_kernel(
-            *refs, None, t_real=t_real, t_pad=t_pad, causal=causal,
-            scale=scale, block=block,
+            *unpack(refs), None, t_real=t_real, t_pad=t_pad,
+            causal=causal, scale=scale, block=block, window=window,
         ),
         grid=(bh, n_blk, n_blk),
         in_specs=[
             tile(q_stream), tile(kv_res), tile(kv_res),
             tile(q_stream), rows(q_stream), rows(q_stream),
+            *seg_specs(
+                lambda b, j, i: (b // seg_div, i, 0),
+                lambda b, j, i: (b // seg_div, 0, j),
+            ),
         ],
         out_specs=[tile(dkv_res), tile(dkv_res)],
         out_shape=dkv_out_shape,
         scratch_shapes=dkv_scratch,
         interpret=interpret,
-    )(q, k, v, do, lse_b, delta_b)
+    )(q, k, v, do, lse_b, delta_b, *seg_in)
     return dq, dk, dv
 
 
@@ -605,45 +813,51 @@ def _pad_to(x, t_pad, d_pad):
     return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, d_pad - d)))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
-    return _flash_fwd_res(q, k, v, causal)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash(q, k, v, seg, causal, window):
+    return _flash_fwd_res(q, k, v, seg, causal, window)[0]
 
 
-def _flash_fwd_res(q, k, v, causal):
+def _flash_fwd_res(q, k, v, seg, causal, window):
     bh, t, d = q.shape
     t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
     d_pad = -(-d // _LANES) * _LANES
     scale = float(1.0 / (d**0.5))
     qp, kp, vp = (_pad_to(a, t_pad, d_pad) for a in (q, k, v))
+    qso, kso = (
+        _seg_operands(seg, t_pad) if seg is not None else (None, None)
+    )
     o, lse = _flash_fwd_padded(
-        qp, kp, vp, causal=causal, interpret=_interpret(), t_real=t,
-        scale=scale,
+        qp, kp, vp, qso, kso, causal=causal, interpret=_interpret(),
+        t_real=t, scale=scale, window=window,
     )
     return o[:, :t, :d], lse[:, :t]
 
 
-def _flash_fwd(q, k, v, causal):
-    o, lse = _flash_fwd_res(q, k, v, causal)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, seg, causal, window):
+    o, lse = _flash_fwd_res(q, k, v, seg, causal, window)
+    return o, (q, k, v, seg, o, lse)
 
 
-def _flash_bwd(causal, res, do):
-    q, k, v, o, lse = res
+def _flash_bwd(causal, window, res, do):
+    q, k, v, seg, o, lse = res
     bh, t, d = q.shape
     group = bh // k.shape[0]
     t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
     d_pad = -(-d // _LANES) * _LANES
     scale = float(1.0 / (d**0.5))
     qp, kp, vp, op, dop = (_pad_to(a, t_pad, d_pad) for a in (q, k, v, o, do))
+    qso, kso = (
+        _seg_operands(seg, t_pad) if seg is not None else (None, None)
+    )
     # padded q rows get lse=+BIG so their recomputed probabilities
     # underflow to exactly 0 (an -inf pad would make exp(0 - lse) blow
     # up: padded q rows are zeros, not masked, so their s entries are 0);
     # their cotangent rows are zero-padded too, killing every grad term
     lse_p = jnp.pad(lse, ((0, 0), (0, t_pad - t)), constant_values=1e30)
     dq, dk, dv = _flash_bwd_padded(
-        qp, kp, vp, op, lse_p, dop, causal=causal, interpret=_interpret(),
-        t_real=t, scale=scale,
+        qp, kp, vp, op, lse_p, dop, qso, kso, causal=causal,
+        interpret=_interpret(), t_real=t, scale=scale, window=window,
     )
     if group > 1:
         # per-q-head partials -> kv heads: flat q index = kv_index*G + g,
@@ -652,14 +866,27 @@ def _flash_bwd(causal, res, do):
         dk = dk.astype(jnp.float32).sum(axis=1).astype(k.dtype)
         dv = dv.reshape(v.shape[0], group, t_pad, d_pad)
         dv = dv.astype(jnp.float32).sum(axis=1).astype(v.dtype)
-    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
+    dseg = None if seg is None else _int_zero_tangent(seg)
+    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d], dseg
+
+
+def _int_zero_tangent(x):
+    """float0 cotangent for integer primal inputs (segment ids)."""
+    import numpy as np
+
+    return np.zeros(x.shape, jax.dtypes.float0)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    window: int | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Memory-efficient attention. (..., T, d) -> (..., T, d).
 
@@ -669,6 +896,17 @@ def flash_attention(
     Grouped-query attention: k/v may carry FEWER heads than q on the -3
     dim (H = G * Hkv, MQA at Hkv=1); each group of G consecutive q heads
     attends the same kv head. All other leading dims must match.
+
+    ``window`` (requires ``causal``) restricts each row to the previous
+    ``window`` positions (itself included) — sliding-window attention.
+    The packed banded grids then ONLY iterate in-band blocks, so cost
+    scales with T * window instead of T^2 in forward AND backward.
+
+    ``segment_ids`` (batch-shaped: ``q.shape[:-3] + (T,)``, integers)
+    masks cross-segment attention for packed-sequence training; rows in
+    different segments never attend each other. Block skipping does not
+    apply (segments are runtime data) — combine with ``causal`` to keep
+    the triangular skip.
     """
     shape = q.shape
     t, d = shape[-2], shape[-1]
@@ -685,6 +923,23 @@ def flash_attention(
             )
     if k.shape != v.shape:
         raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     q3 = q.reshape(-1, t, d)
     k3, v3 = (a.reshape(-1, t, d) for a in (k, v))
-    return _flash(q3, k3, v3, causal).reshape(shape)
+    seg = None
+    if segment_ids is not None:
+        want = (*shape[:-3], shape[-2]) if q.ndim >= 3 else (t,)
+        if segment_ids.shape != want:
+            raise ValueError(
+                f"segment_ids must be batch-shaped {want} (no head dim); "
+                f"got {segment_ids.shape}"
+            )
+        # stays batch-lead end to end: the kernels' BlockSpec index maps
+        # divide the flat (B*H) grid index by the head count, so the ids
+        # are never replicated per head in HBM
+        seg = segment_ids.reshape(-1, t)
+    return _flash(q3, k3, v3, seg, causal, window).reshape(shape)

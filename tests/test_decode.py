@@ -312,3 +312,27 @@ def test_gqa_forecast_eta_runs_end_to_end():
     eta, reached = forecast_eta(model, state.params, prog, stats, horizon=30)
     assert eta.shape == (2,) and reached.shape == (2,)
     assert np.isfinite(np.asarray(eta)).all()
+
+
+def test_windowed_model_decode_matches_full_forward():
+    """A sliding-window model's cached decode must reproduce its own
+    windowed training forward (the cache mask bands identically)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2, window=6)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(5), 24, model=model)
+    rng = np.random.default_rng(5)
+    t = 24
+    prog = jnp.asarray(np.cumsum(2.0 + rng.normal(0, 0.3, (2, t + 1)), axis=-1))
+    stats = jnp.full((2, t + 1), TelemetryStatusEntry.CONVERTING)
+    feats, _ = stream_features(prog, stats)
+
+    full = model.apply(state.params, feats)
+    split = 8
+    _, cache = prefill(model, state.params, feats[:, :split], max_len=t)
+    preds = []
+    for i in range(split, t):
+        pred, cache = decode_step(model, state.params, cache, feats[:, i])
+        preds.append(pred)
+    got = jnp.stack(preds, axis=1)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(full[:, split:]), rtol=2e-3, atol=2e-4
+    )

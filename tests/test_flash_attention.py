@@ -247,3 +247,132 @@ def test_gqa_rejects_bad_head_ratio():
     q, k, v = _gqa_qkv(2, h=4, hkv=3)
     with pytest.raises(ValueError, match="GQA"):
         flash_attention(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# sliding-window and segment-id (packed sequence) attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 7, 64, 250, 10_000])
+def test_window_matches_reference(window):
+    """Banded grids at several window/block ratios, incl. window=1 (each
+    row sees itself only) and window >= T (degenerates to plain causal)."""
+    q, k, v = _qkv(10, b=1, h=2, t=300, d=16)
+    want = full_attention(q, k, v, causal=True, window=window)
+    got = flash_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+    if window >= 300:
+        plain = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(plain), rtol=1e-5, atol=1e-6
+        )
+
+
+@pytest.mark.parametrize("window", [5, 100])
+def test_window_gradients_match_reference(window):
+    q, k, v = _qkv(11, b=1, h=2, t=300, d=16)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=True, window=window) ** 2
+        )
+
+    want = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
+def test_window_grid_is_banded_not_triangular():
+    """The packed grid must shrink with the window: live steps scale with
+    T * window, not T^2."""
+    from beholder_tpu.ops.flash_attention import _band_tables, _pick_block
+
+    t_pad, window = 16384, 256
+    block = _pick_block(t_pad, window)
+    n_blk = t_pad // block
+    qi, kj, first, last = _band_tables(n_blk, block, window)
+    full = n_blk * (n_blk + 1) // 2
+    assert qi.shape[0] <= 3 * n_blk  # ~2 blocks per q row, not n_blk/2
+    assert qi.shape[0] < full / 8
+    # flags: exactly one first and one last per q tile
+    assert int(first.sum()) == n_blk and int(last.sum()) == n_blk
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_segment_ids_match_reference(causal):
+    """Packed-sequence masking, incl. runtime fully-masked blocks (the
+    unsorted case puts disjoint segments in the same block pair)."""
+    q, k, v = _qkv(12, b=2, h=2, t=300, d=16)
+    rng = np.random.default_rng(2)
+    seg = jnp.asarray(np.sort(rng.integers(0, 4, (2, 300)), axis=-1))
+    want = full_attention(q, k, v, causal=causal, segment_ids=seg)
+    got = flash_attention(q, k, v, causal=causal, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_segment_gradients_and_isolation():
+    """Gradients match the reference AND perturbing one segment's inputs
+    leaves another segment's outputs bit-identical (true isolation)."""
+    q, k, v = _qkv(13, b=1, h=2, t=128, d=16)
+    seg = jnp.asarray(np.repeat([0, 1], 64)[None, :])
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, causal=True, segment_ids=seg) ** 2
+        )
+
+    want = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    got = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+    base = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    q2 = q.at[:, :, 64:, :].add(7.0)  # perturb ONLY segment 1's queries
+    out2 = flash_attention(q2, k, v, causal=True, segment_ids=seg)
+    np.testing.assert_array_equal(
+        np.asarray(base[:, :, :64]), np.asarray(out2[:, :, :64])
+    )
+    assert not np.allclose(np.asarray(base[:, :, 64:]), np.asarray(out2[:, :, 64:]))
+
+
+def test_window_segments_gqa_compose():
+    q, k, v = _gqa_qkv(14, b=1, h=4, hkv=2, t=200, d=16)
+    rng = np.random.default_rng(3)
+    seg = jnp.asarray(np.sort(rng.integers(0, 3, (1, 200)), axis=-1))
+    kwargs = dict(causal=True, window=50, segment_ids=seg)
+    want = full_attention(q, k, v, **kwargs)
+    got = flash_attention(q, k, v, **kwargs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, **kwargs) ** 2)
+
+    want_g = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for w, g in zip(want_g, got_g):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
+def test_window_and_segment_validation():
+    q, k, v = _qkv(15, t=64)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, window=8)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match="segment_ids"):
+        flash_attention(
+            q, k, v, segment_ids=jnp.zeros((2, 2, 64), jnp.int32)
+        )
+
+
+def test_full_attention_validates_window_like_flash():
+    """The reference backend must reject the same configs the kernel
+    rejects — otherwise a model silently trains on garbage with
+    attention='full' where attention='flash' raises."""
+    q, k, v = _qkv(16, t=32)
+    with pytest.raises(ValueError, match="causal"):
+        full_attention(q, k, v, window=8)
+    with pytest.raises(ValueError, match="window"):
+        full_attention(q, k, v, causal=True, window=0)
