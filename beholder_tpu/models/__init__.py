@@ -26,8 +26,24 @@ from .sequence import (
     seq_train_step,
     stream_features,
 )
+from .serving import (
+    ContinuousBatcher,
+    PagedKVState,
+    Request,
+    init_paged,
+    paged_admit,
+    paged_decode_tick,
+    paged_release,
+)
 
 __all__ = [
+    "ContinuousBatcher",
+    "PagedKVState",
+    "Request",
+    "init_paged",
+    "paged_admit",
+    "paged_decode_tick",
+    "paged_release",
     "decode_step",
     "forecast_deltas",
     "forecast_eta",

@@ -1,0 +1,406 @@
+"""Serving v2: paged KV cache + continuous batching.
+
+EXTENSION BEYOND THE REFERENCE (which has no inference of any kind —
+SURVEY.md §0). :mod:`beholder_tpu.models.decode` serves a FIXED batch
+with one dense (B, Hkv, max_len, Dh) cache per layer; this module serves
+a CHANGING population of requests the way modern LLM servers do
+(vLLM-style), re-thought for XLA's static-shape compilation model:
+
+- **Paged pool.** Each layer's cache is a (num_pages, Hkv, page_size,
+  Dh) pool; a sequence owns a list of pages (``page_table`` row). Memory
+  scales with TOKENS IN FLIGHT, not slots x max_len: short and long
+  requests share the pool, and a retiring request returns its pages to a
+  free stack for the next admit.
+- **Static shapes everywhere.** The decode tick is ONE compiled program
+  for all slots: gather each slot's pages into a transient view
+  (XLA gather), run the model's cached decode with PER-SLOT positions
+  (each slot sits at its own length — the vector-index cache path in
+  :class:`~beholder_tpu.models.sequence.Block`), scatter the new kv
+  column back into the pool. Admission and retirement are also fixed
+  shape: page allocation is a masked vectorized stack pop, freeing a
+  masked push — no data-dependent Python in jit.
+- **Continuous batching.** The host-side :class:`ContinuousBatcher`
+  admits queued requests into free slots mid-flight, ticks all active
+  slots together, and retires finished ones — the accelerator never
+  waits for the longest request in a "static batch" to finish. The only
+  host<->device traffic per tick is the (slots,) predictions readback
+  that the batcher feeds back as the next inputs.
+
+The paged decode is numerically equivalent to the dense per-request
+rollout (pinned by ``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beholder_tpu.ops import NUM_STATUSES
+
+from .sequence import FEATURES, TelemetrySequenceModel
+
+
+class PagedKVState(NamedTuple):
+    """Paged serving state (a pytree; every leaf has a static shape).
+
+    - ``k_pools``/``v_pools``: per-layer (num_pages, Hkv, page, Dh)
+    - ``page_table``: (slots, max_pages) pool indices per slot
+    - ``seq_lens``: (slots,) tokens written per slot
+    - ``active``: (slots,) bool
+    - ``free_stack``: (num_pages,) pool indices; ``free_stack[:free_top]``
+      are free
+    - ``alloc_failed``: sticky error flag (pool exhausted / table
+      overflow) — checked host-side by the batcher
+    """
+
+    k_pools: tuple
+    v_pools: tuple
+    page_table: jax.Array
+    seq_lens: jax.Array
+    active: jax.Array
+    free_stack: jax.Array
+    free_top: jax.Array
+    alloc_failed: jax.Array
+
+
+def init_paged(
+    model: TelemetrySequenceModel,
+    num_pages: int,
+    page_size: int,
+    slots: int,
+    max_pages_per_seq: int,
+) -> PagedKVState:
+    dh = model.dim // model.heads
+    hkv = model.kv_heads or model.heads
+    shape = (num_pages, hkv, page_size, dh)
+    k_pools = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
+    v_pools = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
+    return PagedKVState(
+        k_pools,
+        v_pools,
+        jnp.zeros((slots, max_pages_per_seq), jnp.int32),
+        jnp.zeros((slots,), jnp.int32),
+        jnp.zeros((slots,), bool),
+        jnp.arange(num_pages, dtype=jnp.int32),
+        jnp.int32(num_pages),
+        jnp.zeros((), bool),
+    )
+
+
+def _pop_pages(state: PagedKVState, need: jax.Array):
+    """Vectorized masked stack pop: slot i with ``need[i]`` gets page
+    ``free_stack[free_top - 1 - rank_i]`` where rank_i numbers the
+    needers. Returns (pages (slots,), new_top, failed)."""
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1
+    n = need.sum().astype(jnp.int32)
+    idx = state.free_top - 1 - rank
+    failed = state.alloc_failed | (n > state.free_top)
+    pages = state.free_stack[jnp.clip(idx, 0, state.free_stack.shape[0] - 1)]
+    return pages, state.free_top - n, failed
+
+
+def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
+    """Give every active slot whose next write position opens a fresh
+    page (len % page == 0) a page off the free stack."""
+    page = state.k_pools[0].shape[2]
+    slots, max_pages = state.page_table.shape
+    need = state.active & (state.seq_lens % page == 0)
+    pages, new_top, failed = _pop_pages(state, need)
+    pidx = state.seq_lens // page
+    failed = failed | jnp.any(need & (pidx >= max_pages))
+    rows = jnp.where(need, jnp.arange(slots), slots)  # OOB row -> dropped
+    table = state.page_table.at[
+        rows, jnp.clip(pidx, 0, max_pages - 1)
+    ].set(pages, mode="drop")
+    return state._replace(
+        page_table=table, free_top=new_top, alloc_failed=failed
+    )
+
+
+def _views(state: PagedKVState):
+    """Transient dense (slots, Hkv, max_pages*page, Dh) gather of each
+    slot's pages, per layer. The POOL is the persistent storage; these
+    views live only inside one decode tick."""
+    table = state.page_table  # (S, P)
+    s, p = table.shape
+
+    def one(pool):
+        g = pool[table]                      # (S, P, Hkv, page, Dh)
+        g = g.transpose(0, 2, 1, 3, 4)       # (S, Hkv, P, page, Dh)
+        return g.reshape(s, g.shape[1], p * g.shape[3], g.shape[4])
+
+    return tuple(one(k) for k in state.k_pools), tuple(
+        one(v) for v in state.v_pools
+    )
+
+
+def _scatter_column(pool, pages, offsets, cols):
+    """pool[(pages[i], :, offsets[i], :)] = cols[i] with OOB pages
+    dropped (inactive slots)."""
+    return pool.at[pages, :, offsets, :].set(
+        cols.astype(pool.dtype), mode="drop"
+    )
+
+
+def paged_decode_tick(
+    model: TelemetrySequenceModel, params, state: PagedKVState, feats_t
+):
+    """One continuous-batching decode step for ALL slots.
+
+    ``feats_t`` is (slots, FEATURES); inactive slots run too (their
+    writes are dropped, their outputs ignored) — that is what keeps the
+    tick a single compiled program. Returns ((slots,) predictions,
+    updated state)."""
+    state = _alloc_for_tick(state)
+    page = state.k_pools[0].shape[2]
+    slots = state.page_table.shape[0]
+    k_views, v_views = _views(state)
+
+    preds, new_kvs = model.apply(
+        params,
+        feats_t[:, None, :],
+        cache=(k_views, v_views, state.seq_lens),
+    )
+
+    rows = jnp.arange(slots)
+    pidx = jnp.clip(state.seq_lens // page, 0, state.page_table.shape[1] - 1)
+    pages = jnp.where(
+        state.active,
+        state.page_table[rows, pidx],
+        state.k_pools[0].shape[0],  # OOB -> dropped
+    )
+    offsets = state.seq_lens % page
+    k_pools, v_pools = [], []
+    for layer, (k_view, v_view) in enumerate(new_kvs):
+        # the model wrote each slot's new kv column into its view at the
+        # slot's own position; persist that column into the pool
+        k_col = k_view[rows, :, state.seq_lens, :]  # (S, Hkv, Dh)
+        v_col = v_view[rows, :, state.seq_lens, :]
+        k_pools.append(
+            _scatter_column(state.k_pools[layer], pages, offsets, k_col)
+        )
+        v_pools.append(
+            _scatter_column(state.v_pools[layer], pages, offsets, v_col)
+        )
+
+    state = state._replace(
+        k_pools=tuple(k_pools),
+        v_pools=tuple(v_pools),
+        seq_lens=state.seq_lens + state.active.astype(jnp.int32),
+    )
+    return preds[:, 0], state
+
+
+def paged_admit(
+    model: TelemetrySequenceModel,
+    params,
+    state: PagedKVState,
+    slot: jax.Array,
+    feats_padded: jax.Array,
+    prefix_len: jax.Array,
+):
+    """Admit one request into ``slot``: prefill its (1, T_max, F) padded
+    prefix in one forward, allocate ceil(prefix_len/page) pages, and
+    write the prefix kv into them. Returns ((,) last prediction, state).
+
+    The page count is data-dependent but the WORK is not: the masked
+    writes cover all T_max//page chunks and drop the dead ones.
+    """
+    page = state.k_pools[0].shape[2]
+    num_pages = state.k_pools[0].shape[0]
+    slots, max_pages = state.page_table.shape
+    t_max = feats_padded.shape[1]
+    if t_max % page:
+        raise ValueError(f"padded prefix {t_max} not a page multiple ({page})")
+    p_max = t_max // page
+
+    preds, kvs = model.apply(params, feats_padded, return_kv=True)
+    last_pred = preds[0, jnp.clip(prefix_len - 1, 0, t_max - 1)]
+
+    n_pages = -(-prefix_len // page)  # ceil
+    chunk_alive = jnp.arange(p_max) < n_pages
+    pages, new_top, failed = _pop_pages(state, chunk_alive)  # (p_max,)
+    failed = failed | (n_pages > max_pages)
+    table_row = jnp.where(
+        jnp.arange(max_pages) < n_pages,
+        jnp.pad(pages, (0, max(0, max_pages - p_max)))[:max_pages],
+        0,
+    )
+
+    k_pools, v_pools = [], []
+    drop = jnp.where(chunk_alive, pages, num_pages)     # OOB -> dropped
+    for layer, (k, v) in enumerate(kvs):
+        # (1, Hkv, T_max, Dh) -> (p_max, Hkv, page, Dh) page chunks
+        def chunks(a):
+            a = a[0].transpose(1, 0, 2)                 # (T_max, Hkv, Dh)
+            a = a.reshape(p_max, page, a.shape[1], a.shape[2])
+            return a.transpose(0, 2, 1, 3)
+        k_pools.append(
+            state.k_pools[layer].at[drop].set(
+                chunks(k).astype(state.k_pools[layer].dtype), mode="drop"
+            )
+        )
+        v_pools.append(
+            state.v_pools[layer].at[drop].set(
+                chunks(v).astype(state.v_pools[layer].dtype), mode="drop"
+            )
+        )
+
+    state = state._replace(
+        k_pools=tuple(k_pools),
+        v_pools=tuple(v_pools),
+        page_table=state.page_table.at[slot].set(table_row),
+        seq_lens=state.seq_lens.at[slot].set(prefix_len),
+        active=state.active.at[slot].set(True),
+        free_top=new_top,
+        alloc_failed=failed,
+    )
+    return last_pred, state
+
+
+def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
+    """Retire ``slot``: push its pages back onto the free stack."""
+    page = state.k_pools[0].shape[2]
+    num_pages = state.k_pools[0].shape[0]
+    max_pages = state.page_table.shape[1]
+    n = -(-state.seq_lens[slot] // page)
+    alive = jnp.arange(max_pages) < n
+    dest = jnp.where(
+        alive, state.free_top + jnp.arange(max_pages), num_pages
+    )
+    stack = state.free_stack.at[dest].set(
+        state.page_table[slot], mode="drop"
+    )
+    return state._replace(
+        free_stack=stack,
+        free_top=state.free_top + n,
+        active=state.active.at[slot].set(False),
+        seq_lens=state.seq_lens.at[slot].set(0),
+    )
+
+
+class Request(NamedTuple):
+    progress: np.ndarray   # (T+1,) observed progress
+    statuses: np.ndarray   # (T+1,) observed statuses
+    horizon: int
+
+
+class ContinuousBatcher:
+    """Host-side vLLM-style scheduler over the paged state.
+
+    Submit any number of :class:`Request`\\ s, then :meth:`run`. The
+    batcher admits requests into free slots as they open (prefill is one
+    jit per admission; padded to ``max_prefix``), ticks every active
+    slot in one compiled step, feeds each slot's prediction back as its
+    next input, and retires slots whose horizon is exhausted — freeing
+    their pages for queued requests. Results are per-request forecast
+    delta arrays, equal to the dense per-request rollout.
+    """
+
+    def __init__(
+        self,
+        model: TelemetrySequenceModel,
+        params,
+        *,
+        num_pages: int = 64,
+        page_size: int = 16,
+        slots: int = 4,
+        max_prefix: int = 64,
+        max_pages_per_seq: int = 32,
+    ):
+        self.model = model
+        self.params = params
+        self.page_size = page_size
+        self.max_prefix = -(-max_prefix // page_size) * page_size
+        self.state = init_paged(
+            model, num_pages, page_size, slots, max_pages_per_seq
+        )
+        self.slots = slots
+        self._tick = jax.jit(
+            lambda p, s, f: paged_decode_tick(model, p, s, f)
+        )
+        self._admit = jax.jit(
+            lambda p, s, slot, feats, n: paged_admit(
+                model, p, s, slot, feats, n
+            )
+        )
+        self._release = jax.jit(paged_release)
+
+    def run(self, requests: list[Request]) -> list[np.ndarray]:
+        from .sequence import stream_features
+
+        queue = list(enumerate(requests))
+        results: list = [None] * len(requests)
+        # per-slot host bookkeeping
+        req_of = [None] * self.slots
+        deltas: list = [None] * self.slots
+        remaining = np.zeros(self.slots, np.int64)
+        last_pred = np.zeros(self.slots, np.float32)
+        status_oh = np.zeros((self.slots, NUM_STATUSES), np.float32)
+
+        while queue or any(r is not None for r in req_of):
+            # admit while there is a free slot and a queued request
+            for slot in range(self.slots):
+                if not queue or req_of[slot] is not None:
+                    continue
+                rid, req = queue.pop(0)
+                feats, _ = stream_features(
+                    jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
+                )
+                t = feats.shape[1]
+                if t > self.max_prefix:
+                    raise ValueError(
+                        f"prefix {t} exceeds max_prefix {self.max_prefix}"
+                    )
+                padded = jnp.pad(
+                    feats, ((0, 0), (0, self.max_prefix - t), (0, 0))
+                )
+                pred, self.state = self._admit(
+                    self.params, self.state, jnp.int32(slot), padded,
+                    jnp.int32(t),
+                )
+                if bool(self.state.alloc_failed):
+                    raise RuntimeError(
+                        "page pool exhausted — raise num_pages or lower "
+                        "concurrency"
+                    )
+                if req.horizon <= 0:
+                    # forecast_deltas(horizon=0) returns an empty array;
+                    # release immediately instead of ticking forever
+                    results[rid] = np.zeros(0, np.float32)
+                    self.state = self._release(self.state, jnp.int32(slot))
+                    continue
+                req_of[slot] = rid
+                deltas[slot] = []
+                remaining[slot] = req.horizon
+                last_pred[slot] = float(pred)
+                status_oh[slot] = np.asarray(
+                    jax.nn.one_hot(int(req.statuses[-1]), NUM_STATUSES)
+                )
+
+            # one compiled tick for every slot (inactive slots ride along)
+            feats_t = jnp.asarray(
+                np.concatenate([last_pred[:, None], status_oh], axis=1),
+                jnp.float32,
+            )
+            preds, self.state = self._tick(self.params, self.state, feats_t)
+            if bool(self.state.alloc_failed):
+                raise RuntimeError("page pool exhausted mid-decode")
+            preds = np.asarray(preds)
+
+            for slot in range(self.slots):
+                if req_of[slot] is None:
+                    continue
+                deltas[slot].append(last_pred[slot])
+                last_pred[slot] = preds[slot]
+                remaining[slot] -= 1
+                if remaining[slot] <= 0:
+                    results[req_of[slot]] = np.asarray(
+                        deltas[slot], np.float32
+                    )
+                    self.state = self._release(self.state, jnp.int32(slot))
+                    req_of[slot] = None
+        return results

@@ -1,0 +1,210 @@
+"""Paged KV cache + continuous batching vs the dense per-request rollout.
+
+Two layers of pinning:
+
+- TEACHER-FORCED equivalence (tight): drive the paged primitives and the
+  dense decode with the SAME preset inputs — no prediction feedback — so
+  per-tick outputs differ only by direct float-lowering ULPs (a (slots,)
+  batched matmul lowers differently than the dense path's B=1), never
+  amplified. The caches must agree to bf16 exactness.
+- Product-level forecast (loose): the batcher feeds its own predictions
+  back, so ULP differences amplify chaotically with horizon; the
+  forecast is checked against ``forecast_deltas`` at rollout-chaos
+  tolerance only.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.models import (
+    TelemetrySequenceModel,
+    forecast_deltas,
+    init_seq_state,
+)
+from beholder_tpu.models import serving as sv
+from beholder_tpu.models.decode import decode_step, prefill
+from beholder_tpu.models.serving import ContinuousBatcher, Request
+from beholder_tpu.models.sequence import stream_features
+from beholder_tpu.ops import NUM_STATUSES
+from beholder_tpu.proto import TelemetryStatusEntry
+
+
+def _request(seed, t, horizon):
+    rng = np.random.default_rng(seed)
+    prog = np.cumsum(2.0 + rng.normal(0, 0.3, t + 1))
+    stats = np.full(t + 1, TelemetryStatusEntry.CONVERTING)
+    return Request(prog, stats, horizon)
+
+
+def _feats(req):
+    return stream_features(
+        jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
+    )[0]
+
+
+@pytest.mark.parametrize(
+    "model_kwargs",
+    [
+        {},
+        {"heads": 4, "kv_heads": 1},        # MQA serving
+        {"window": 6},                      # sliding-window serving
+    ],
+    ids=["mha", "mqa", "window"],
+)
+def test_paged_decode_matches_dense_teacher_forced(model_kwargs):
+    """Two slots at DIFFERENT lengths (the vector-index cache path),
+    page-boundary crossings mid-run, same preset inputs as two dense B=1
+    rollouts: per-tick predictions and cache contents must agree."""
+    model = TelemetrySequenceModel(
+        **{"dim": 32, "heads": 2, "layers": 2, **model_kwargs}
+    )
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    params = state0.params
+
+    reqs = [_request(0, t=13, horizon=0), _request(1, t=9, horizon=0)]
+    f0, f1 = _feats(reqs[0]), _feats(reqs[1])
+    oh = np.asarray(jax.nn.one_hot(TelemetryStatusEntry.CONVERTING, NUM_STATUSES))
+    rng = np.random.default_rng(7)
+    forced = rng.normal(0, 1, (12, 2)).astype(np.float32)  # preset deltas
+
+    # paged: 2 slots, view width 8 pages x 8 = 64
+    state = sv.init_paged(model, num_pages=16, page_size=8, slots=2,
+                          max_pages_per_seq=8)
+    _, state = sv.paged_admit(
+        model, params, state, jnp.int32(0),
+        jnp.pad(f0, ((0, 0), (0, 16 - 13), (0, 0))), jnp.int32(13),
+    )
+    _, state = sv.paged_admit(
+        model, params, state, jnp.int32(1),
+        jnp.pad(f1, ((0, 0), (0, 16 - 9), (0, 0))), jnp.int32(9),
+    )
+
+    # dense references (each its own B=1 cache, width 64 to match)
+    _, c0 = prefill(model, params, f0, 64)
+    _, c1 = prefill(model, params, f1, 64)
+
+    for tick in range(12):
+        feats_t = jnp.asarray(
+            np.concatenate([forced[tick][:, None], np.stack([oh, oh])], axis=1),
+            jnp.float32,
+        )
+        preds, state = sv.paged_decode_tick(model, params, state, feats_t)
+        ft0 = jnp.concatenate([forced[tick][0][None, None], oh[None]], axis=-1)
+        ft1 = jnp.concatenate([forced[tick][1][None, None], oh[None]], axis=-1)
+        d0, c0 = decode_step(model, params, c0, ft0.astype(jnp.float32))
+        d1, c1 = decode_step(model, params, c1, ft1.astype(jnp.float32))
+        # the (slots,) batched matmuls lower differently than the dense
+        # B=1 path; with bf16 params a single tick can differ by one
+        # bf16 ULP (~1e-3 at O(0.2)) without any state divergence
+        np.testing.assert_allclose(
+            np.asarray(preds), np.asarray(jnp.stack([d0[0], d1[0]])),
+            rtol=1e-2, atol=2e-3, err_msg=f"tick {tick}",
+        )
+
+    # caches agree everywhere written (bf16 storage on both paths)
+    k_views, v_views = sv._views(state)
+    for layer in range(model.layers):
+        for slot, cache, t0 in ((0, c0, 13), (1, c1, 9)):
+            ln = t0 + 12
+            np.testing.assert_allclose(
+                np.asarray(k_views[layer][slot][:, :ln], np.float32),
+                np.asarray(cache.keys[layer][0][:, :ln], np.float32),
+                rtol=1e-2, atol=1e-3,
+            )
+    assert not bool(state.alloc_failed)
+
+
+def test_continuous_batcher_end_to_end():
+    """More requests than slots, mixed lengths/horizons: the batcher's
+    fed-back forecasts track the product-level dense forecast (loose —
+    feedback amplifies ULPs), pages recycle fully, and results come back
+    for every request."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+
+    requests = [
+        _request(0, t=24, horizon=5),
+        _request(1, t=9, horizon=12),
+        _request(2, t=17, horizon=3),
+        _request(3, t=30, horizon=8),
+        _request(4, t=5, horizon=10),
+    ]
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=24, page_size=8, slots=2, max_prefix=32,
+        max_pages_per_seq=8,
+    )
+    results = batcher.run(requests)
+
+    for i, req in enumerate(requests):
+        want = np.asarray(
+            forecast_deltas(
+                model, state.params,
+                jnp.asarray(req.progress)[None],
+                jnp.asarray(req.statuses)[None],
+                req.horizon,
+            )[0],
+            np.float32,
+        )
+        assert results[i].shape == want.shape
+        # first few steps are feedback-free enough to check tightly
+        # (bf16-ULP tolerance; see the teacher-forced test)
+        np.testing.assert_allclose(
+            results[i][:2], want[:2], rtol=1e-2, atol=2e-3,
+            err_msg=f"request {i}",
+        )
+        np.testing.assert_allclose(
+            results[i], want, rtol=0.25, atol=0.05, err_msg=f"request {i}"
+        )
+    assert int(batcher.state.free_top) == 24  # every page came home
+    assert not bool(batcher.state.active.any())
+
+
+def test_pool_memory_scales_with_tokens_not_slots():
+    """The point of paging: 5 requests whose DENSE caches would need
+    5 x 38 = 190 token slots run through a 12-page x 8 = 96-slot pool,
+    because only ~2 requests are ever resident and retired pages
+    recycle."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(2), 24, model=model)
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=12, page_size=8, slots=2, max_prefix=32,
+        max_pages_per_seq=6,
+    )
+    requests = [_request(i, t=24, horizon=8) for i in range(5)]
+    results = batcher.run(requests)
+    assert all(r is not None and r.shape == (8,) for r in results)
+    assert int(batcher.state.free_top) == 12
+
+
+def test_pool_exhaustion_raises_not_corrupts():
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(3), 16, model=model)
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=2, page_size=8, slots=2, max_prefix=16,
+        max_pages_per_seq=4,
+    )
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        batcher.run([_request(7, t=14, horizon=40)])
+
+
+def test_zero_horizon_request_retires_immediately():
+    """horizon=0 (a value forecast_deltas accepts) must come back as an
+    empty forecast with its pages released — not tick forever."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(4), 16, model=model)
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=8, page_size=8, slots=2, max_prefix=16,
+        max_pages_per_seq=2,
+    )
+    results = batcher.run(
+        [_request(8, t=10, horizon=0), _request(9, t=10, horizon=4)]
+    )
+    assert results[0].shape == (0,)
+    assert results[1].shape == (4,)
+    assert int(batcher.state.free_top) == 8
