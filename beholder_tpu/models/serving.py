@@ -40,7 +40,7 @@ import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
 
-from .sequence import FEATURES, TelemetrySequenceModel
+from .sequence import TelemetrySequenceModel
 
 
 class PagedKVState(NamedTuple):
@@ -347,6 +347,11 @@ class ContinuousBatcher:
                 if not queue or req_of[slot] is not None:
                     continue
                 rid, req = queue.pop(0)
+                if req.horizon <= 0:
+                    # forecast_deltas(horizon=0) returns an empty array;
+                    # skip the prefill/alloc round-trip entirely
+                    results[rid] = np.zeros(0, np.float32)
+                    continue
                 feats, _ = stream_features(
                     jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
                 )
@@ -367,12 +372,6 @@ class ContinuousBatcher:
                         "page pool exhausted — raise num_pages or lower "
                         "concurrency"
                     )
-                if req.horizon <= 0:
-                    # forecast_deltas(horizon=0) returns an empty array;
-                    # release immediately instead of ticking forever
-                    results[rid] = np.zeros(0, np.float32)
-                    self.state = self._release(self.state, jnp.int32(slot))
-                    continue
                 req_of[slot] = rid
                 deltas[slot] = []
                 remaining[slot] = req.horizon

@@ -17,6 +17,8 @@ from .mesh import (
     seq_state_shardings,
     sharded_seq_train_step,
     sharded_train_step,
+    tp_all_reduce,
+    tp_replicate,
 )
 from .pipeline import (
     bubble_fraction,
@@ -42,6 +44,8 @@ __all__ = [
     "place_seq_state",
     "sharded_seq_train_step",
     "sharded_train_step",
+    "tp_all_reduce",
+    "tp_replicate",
     "initialize",
     "make_hybrid_mesh",
     "pipeline_forward",

@@ -150,3 +150,38 @@ def sharded_train_step(tx, mesh: Mesh, state_template: Any):
         in_shardings=(shardings, data, data),
         out_shardings=(shardings, replicated(mesh)),
     )
+
+
+def tp_all_reduce(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Megatron's ``g`` operator for hand-rolled tensor parallelism inside
+    ``shard_map``: all-reduce forward, IDENTITY backward.
+
+    ``jax.lax.psum``'s transpose is ``psum`` again, so a loss computed on
+    tp-replicated activations hands every tp member an identical
+    cotangent and the plain-psum backward multiplies gradients by the tp
+    size. After a row-parallel matmul use THIS instead: the cotangent is
+    already replicated, so the correct per-shard backward is the
+    identity (Megatron-LM's conjugate-operator rule)."""
+
+    @jax.custom_vjp
+    def g(v):
+        return jax.lax.psum(v, axis)
+
+    g.defvjp(lambda v: (jax.lax.psum(v, axis), None), lambda _, dy: (dy,))
+    return g(x)
+
+
+def tp_replicate(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Megatron's ``f`` operator: IDENTITY forward, all-reduce backward.
+
+    Apply to a replicated activation entering column-parallel matmuls:
+    each tp member computes only its shard's contribution to the input
+    gradient, so the backward must sum them (the conjugate of
+    :func:`tp_all_reduce`)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None), lambda _, dy: (jax.lax.psum(dy, axis),))
+    return f(x)

@@ -144,6 +144,7 @@ def pipeline_train_step(
     mesh: Mesh,
     axis: str = "pp",
     dp_axis: str | None = None,
+    param_specs: Any = None,
 ) -> tuple[jax.Array, Any]:
     """One 1F1B training step over ``S = mesh.shape[axis]`` pipeline stages.
 
@@ -180,6 +181,20 @@ def pipeline_train_step(
     NOTE: under ``dp_axis`` the per-shard losses are AVERAGED over dp, so
     ``loss_fn`` must be a mean over its batch dim (the usual convention);
     a sum-type loss would come out a factor of dp small.
+
+    ``param_specs`` overrides the stage-parameter PartitionSpecs (default
+    :func:`stage_specs`: leading dim on ``axis``, rest replicated) —
+    THE hook for tensor parallelism INSIDE pipeline stages: pass specs
+    that additionally shard weight dims over a ``tp`` mesh axis and have
+    ``stage_fn`` run megatron's conjugate collective pair —
+    :func:`~beholder_tpu.parallel.mesh.tp_replicate` before its
+    column-parallel matmul and
+    :func:`~beholder_tpu.parallel.mesh.tp_all_reduce` after its
+    row-parallel matmul (a plain ``jax.lax.psum`` would double-count the
+    replicated cotangent in the backward: psum's transpose is psum).
+    Gradients come back with the same tp sharding and need no extra
+    collective. Pinned by
+    ``tests/test_pipeline.py::test_1f1b_composes_with_tp_inside_stages``.
     """
     s = mesh.shape[axis]
     m = x.shape[0]
@@ -277,11 +292,15 @@ def pipeline_train_step(
             )
         return loss, grads
 
+    p_specs = (
+        param_specs if param_specs is not None
+        else stage_specs(stacked_params, axis)
+    )
     data_spec = P(None, dp_axis) if dp_axis is not None else P()
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(stage_specs(stacked_params, axis), data_spec, data_spec),
-        out_specs=(P(), stage_specs(stacked_params, axis)),
+        in_specs=(p_specs, data_spec, data_spec),
+        out_specs=(P(), p_specs),
         check_vma=False,
     )(stacked_params, x, y)

@@ -316,3 +316,80 @@ def test_1f1b_dp_rejects_indivisible_microbatch():
     x = jnp.zeros((4, 3, DIM))  # 3 % dp=2 != 0
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_train_step(stage_fn, mb_loss, stacked, x, x, mesh, dp_axis="dp")
+
+
+def test_1f1b_composes_with_tp_inside_stages():
+    """dp x pp x tp on one mesh: megatron tensor parallelism INSIDE each
+    1F1B pipeline stage (column-sharded up-projection, row-sharded
+    down-projection, one psum over tp per stage), composed with data
+    parallelism. Loss and gradients — which come back tp-sharded via
+    param_specs — must equal the sequential full-weight reference."""
+    from jax.sharding import PartitionSpec as P
+
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2), ("dp", "pp", "tp"))
+    stages, dim, ff = 2, 8, 16
+
+    keys = jax.random.split(jax.random.PRNGKey(20), 2 * stages)
+    params = {
+        "w1": jnp.stack([  # (S, dim, ff) — ff column-sharded over tp
+            jax.random.normal(keys[2 * i], (dim, ff)) * 0.3
+            for i in range(stages)
+        ]),
+        "w2": jnp.stack([  # (S, ff, dim) — ff row-sharded over tp
+            jax.random.normal(keys[2 * i + 1], (ff, dim)) * 0.3
+            for i in range(stages)
+        ]),
+    }
+    param_specs = {"w1": P("pp", None, "tp"), "w2": P("pp", "tp", None)}
+
+    from beholder_tpu.parallel import tp_all_reduce, tp_replicate
+
+    def stage_fn(p, x):
+        # local shards (stage dim already stripped): w1 (dim, ff/T),
+        # w2 (ff/T, dim). The f/g conjugate pair keeps gradients exact:
+        # plain psum would double-count the replicated cotangent.
+        h = jax.nn.gelu(tp_replicate(x) @ p["w1"])
+        y = tp_all_reduce(h @ p["w2"])  # megatron row-parallel
+        return x + y
+
+    def mb_loss(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    m, bm = 4, 4
+    x = jax.random.normal(jax.random.PRNGKey(21), (m, bm, dim))
+    y = jax.random.normal(jax.random.PRNGKey(22), (m, bm, dim))
+
+    loss, grads = jax.jit(
+        lambda p, x, y: pipeline_train_step(
+            stage_fn, mb_loss, p, x, y, mesh,
+            dp_axis="dp", param_specs=param_specs,
+        )
+    )(params, x, y)
+
+    def seq_loss(p):
+        def apply(x):
+            for i in range(stages):
+                h = jax.nn.gelu(x @ p["w1"][i])
+                x = x + h @ p["w2"][i]
+            return x
+
+        out = jax.vmap(apply)(x)
+        return jnp.mean(jax.vmap(mb_loss)(out, y))
+
+    want_loss, want_grads = jax.value_and_grad(seq_loss)(params)
+    np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        grads,
+        want_grads,
+    )
+    # grads really live (pp, tp)-sharded: 8 devices x (1, dim, ff/2) shards
+    assert grads["w1"].sharding.spec == P("pp", None, "tp")
+    shard_shapes = {tuple(s.data.shape) for s in grads["w1"].addressable_shards}
+    assert shard_shapes == {(1, dim, ff // 2)}
