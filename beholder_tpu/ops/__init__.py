@@ -13,6 +13,13 @@ reference; parity components live in the sibling packages.
 from .aggregate import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
 from .moe import SwitchFFN, expert_shardings, expert_specs
 from .pallas_aggregate import aggregate_telemetry_pallas
+from .quant import (
+    dequantize_params,
+    dequantize_weight,
+    quantize_params,
+    quantize_weight,
+    quantized_nbytes,
+)
 
 __all__ = [
     "NUM_STATUSES",
@@ -23,4 +30,9 @@ __all__ = [
     "SwitchFFN",
     "expert_shardings",
     "expert_specs",
+    "dequantize_params",
+    "dequantize_weight",
+    "quantize_params",
+    "quantize_weight",
+    "quantized_nbytes",
 ]

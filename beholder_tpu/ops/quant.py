@@ -1,0 +1,91 @@
+"""Int8 weight quantization for serving.
+
+EXTENSION BEYOND THE REFERENCE (no tensors there — SURVEY.md §0).
+Weight-only, per-output-channel symmetric int8:
+
+- :func:`quantize_params` maps every 2-D matmul kernel in a trained
+  params tree to ``{"qvalues": int8, "scale": f32 per column}`` —
+  the tree's HBM footprint drops ~4x vs f32 (2x vs bf16). Biases,
+  LayerNorms, and embeddings stay in full precision (they are tiny and
+  precision-critical).
+- :func:`dequantize_params` reconstructs the original tree structure
+  INSIDE jit: the dequant is elementwise, so XLA fuses it into each
+  consumer matmul — int8 stays the HBM-resident representation, the
+  bf16 weight tile exists only in VMEM on its way to the MXU. Decode
+  steps are weight-bandwidth-bound, so halving weight bytes is a direct
+  serving-latency lever (the standard weight-only-quant argument).
+
+Per-channel scales bound the quantization error: for column j,
+``scale_j = max_i |w_ij| / 127``, so the roundoff per weight is at most
+``scale_j / 2`` — outlier columns don't poison the whole matrix the way
+one per-tensor scale would.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+_QKEYS = ("qvalues", "scale")
+
+
+def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
+    """(in, out) matmul kernel -> symmetric int8 with per-OUTPUT-channel
+    scales. ``w ≈ qvalues.astype(f32) * scale``."""
+    if w.ndim != 2:
+        raise ValueError(f"expected a 2-D kernel, got shape {w.shape}")
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # (out,)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {"qvalues": q.astype(jnp.int8), "scale": scale}
+
+
+def dequantize_weight(q: dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`quantize_weight`. Elementwise — inside jit XLA
+    fuses this into the consumer matmul, so the full-precision weight
+    never lands in HBM."""
+    return (q["qvalues"].astype(jnp.float32) * q["scale"]).astype(dtype)
+
+
+def _is_quantizable(path_names: tuple[str, ...], leaf) -> bool:
+    """Quantize only 2-D matmul kernels, and skip the embedding/head
+    projections (input featurization and the scalar output head are
+    precision-critical and tiny)."""
+    if not (path_names and path_names[-1] == "kernel" and leaf.ndim == 2):
+        return False
+    return not any(n in ("embed", "head") for n in path_names)
+
+
+def quantize_params(params: Any) -> Any:
+    """Trained params tree -> same-structure tree with every eligible
+    kernel leaf replaced by its ``{"qvalues", "scale"}`` dict. Works on
+    arbitrary pytree containers (dict/list/tuple) — the replacement dict
+    is grafted at the leaf position."""
+    from jax.tree_util import tree_map_with_path
+
+    def one(path, leaf):
+        names = tuple(
+            str(getattr(p, "key", getattr(p, "name", ""))) for p in path
+        )
+        return quantize_weight(leaf) if _is_quantizable(names, leaf) else leaf
+
+    return tree_map_with_path(one, params)
+
+
+def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
+    """Quantized tree -> apply-ready params (call INSIDE jit; see module
+    docstring for why that keeps int8 as the HBM representation)."""
+    if isinstance(qparams, dict):
+        if set(qparams.keys()) == set(_QKEYS):
+            return dequantize_weight(qparams, dtype)
+        return {k: dequantize_params(v, dtype) for k, v in qparams.items()}
+    if isinstance(qparams, (list, tuple)):
+        return type(qparams)(dequantize_params(v, dtype) for v in qparams)
+    return qparams
+
+
+def quantized_nbytes(tree: Any) -> int:
+    """Total bytes of a (possibly quantized) params tree."""
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(tree))

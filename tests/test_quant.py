@@ -1,0 +1,112 @@
+"""Int8 weight-only quantization: error bounds and model-level accuracy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beholder_tpu.models import (
+    TelemetrySequenceModel,
+    forecast_eta,
+    init_seq_state,
+    seq_train_step,
+    stream_features,
+)
+from beholder_tpu.ops.quant import (
+    dequantize_params,
+    dequantize_weight,
+    quantize_params,
+    quantize_weight,
+    quantized_nbytes,
+)
+from beholder_tpu.proto import TelemetryStatusEntry
+
+
+def test_roundtrip_error_bounded_per_channel():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * jnp.exp(
+        jax.random.normal(jax.random.PRNGKey(1), (1, 32))  # per-col spread
+    )
+    q = quantize_weight(w)
+    assert q["qvalues"].dtype == jnp.int8 and q["scale"].shape == (32,)
+    deq = dequantize_weight(q, jnp.float32)
+    err = jnp.abs(deq - w)
+    # symmetric rounding: error <= scale/2 per column, even with 1000x
+    # scale spread between columns (per-channel beats per-tensor)
+    bound = q["scale"][None, :] * 0.5 + 1e-6
+    assert bool(jnp.all(err <= bound))
+
+
+def test_quantize_params_structure_and_size():
+    model = TelemetrySequenceModel(dim=64, heads=4, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(2), 16, model=model)
+    qp = quantize_params(state.params)
+
+    blk = qp["params"]["block_0"]
+    assert blk["q_proj"]["kernel"]["qvalues"].dtype == jnp.int8
+    # embed/head stay full precision (precision-critical featurization)
+    assert qp["params"]["embed"]["kernel"].dtype == state.params["params"][
+        "embed"
+    ]["kernel"].dtype
+    assert qp["params"]["head"]["kernel"].dtype != jnp.int8
+
+    full = quantized_nbytes(state.params)
+    quant = quantized_nbytes(qp)
+    assert quant < 0.45 * full, (quant, full)  # ~4x on the matmul kernels
+
+    # dequantized tree has the original structure and shapes
+    deq = dequantize_params(qp)
+    assert jax.tree.structure(deq) == jax.tree.structure(state.params)
+    for a, b in zip(jax.tree.leaves(deq), jax.tree.leaves(state.params)):
+        assert a.shape == b.shape
+
+
+def test_quantized_model_tracks_full_precision():
+    """Train briefly, quantize, and compare scoring + forecasts: int8
+    weights must track the bf16 model closely (per-channel scales)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    t = 24
+    state, tx, _ = init_seq_state(jax.random.PRNGKey(3), t, model=model)
+    rng = np.random.default_rng(3)
+    prog = jnp.asarray(np.cumsum(2.0 + rng.normal(0, 0.3, (4, t + 1)), axis=-1))
+    stats = jnp.full((4, t + 1), TelemetryStatusEntry.CONVERTING)
+    feats, targets = stream_features(prog, stats)
+    step = jax.jit(lambda s, f, t: seq_train_step(model, tx, s, f, t))
+    for _ in range(20):
+        state, _ = step(state, feats, targets)
+
+    qp = quantize_params(state.params)
+    # dequant INSIDE jit — int8 is the HBM-resident representation
+    scores_q = jax.jit(
+        lambda qp, f: model.apply(dequantize_params(qp), f)
+    )(qp, feats)
+    scores = model.apply(state.params, feats)
+    # relative error of the predictions stays in the int8 regime
+    denom = np.maximum(np.abs(np.asarray(scores)), 0.1)
+    rel = np.abs(np.asarray(scores_q) - np.asarray(scores)) / denom
+    assert float(rel.mean()) < 0.05, float(rel.mean())
+
+    eta, reached = forecast_eta(model, state.params, prog, stats, horizon=20)
+    eta_q, reached_q = jax.jit(
+        lambda qp, p, s: forecast_eta(
+            model, dequantize_params(qp), p, s, 20
+        )
+    )(qp, prog, stats)
+    # ETA is an integer decision over a fed-back rollout; allow 2 steps
+    assert np.all(np.abs(np.asarray(eta) - np.asarray(eta_q)) <= 2)
+
+
+def test_quantize_params_preserves_list_containers():
+    """Non-dict containers (lists of per-layer dicts) must survive —
+    the path-keyed rebuild used to collapse list siblings."""
+    tree = {
+        "layers": [
+            {"kernel": jnp.ones((8, 4)), "bias": jnp.zeros(4)},
+            {"kernel": 2.0 * jnp.ones((8, 4)), "bias": jnp.ones(4)},
+        ]
+    }
+    qp = quantize_params(tree)
+    assert isinstance(qp["layers"], list) and len(qp["layers"]) == 2
+    assert qp["layers"][0]["kernel"]["qvalues"].dtype == jnp.int8
+    deq = dequantize_params(qp, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(deq["layers"][1]["kernel"]), 2.0, rtol=1e-2
+    )
