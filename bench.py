@@ -535,7 +535,7 @@ def bench_decode() -> dict:
     }
 
 
-ACCEL_TIMEOUT_S = 900
+ACCEL_TIMEOUT_S = 1500  # flash + decode benches, cold-compile worst case
 
 
 def _run_accel_benches() -> dict:
