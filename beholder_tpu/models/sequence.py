@@ -95,8 +95,9 @@ class Block(nn.Module):
     #: holds whole kv heads.
     kv_heads: int | None = None
     #: sliding-window attention: each position attends only the previous
-    #: ``window`` positions (flash/full backends; the packed banded
-    #: kernel grid makes cost scale with T * window)
+    #: ``window`` positions (flash/full/ring backends; the packed banded
+    #: kernel grid — and ring's bounded rotations — make cost scale with
+    #: T * window)
     window: int | None = None
 
     @nn.compact
@@ -180,23 +181,22 @@ class Block(nn.Module):
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")
-            if self.window is not None and self.attention not in (
-                "flash", "full"
-            ):
+            if self.window is not None and self.attention == "ulysses":
                 raise ValueError(
-                    f"window is supported by the flash/full backends, "
-                    f"not {self.attention!r}"
+                    "window is supported by the flash/full/ring backends, "
+                    "not 'ulysses'"
                 )
             kv_out = (k, v)  # cache k/v keep their hkv heads
-            if self.attention in ("ring", "ulysses") and hkv != h:
-                # the sp collectives (ppermute / all-to-all) move k/v by
-                # whole heads; broadcast kv groups up front so every
-                # device's rotation carries complete heads. The kv-memory
-                # saving is a CACHE property — training keeps full FLOPs.
+            if self.attention == "ulysses" and hkv != h:
+                # Ulysses' all-to-all splits the HEAD dim over sp, so kv
+                # groups broadcast up front; ring and flash are GQA-native
+                # (ring even shrinks its rotating blocks by the group)
                 k = jnp.repeat(k, h // hkv, axis=1)
                 v = jnp.repeat(v, h // hkv, axis=1)
             if self.attention == "ring":
-                att = ring_attention(q, k, v, self.mesh, causal=True)
+                att = ring_attention(
+                    q, k, v, self.mesh, causal=True, window=self.window
+                )
             elif self.attention == "ulysses":
                 att = ulysses_attention(q, k, v, self.mesh, causal=True)
             elif self.attention == "flash":
@@ -250,7 +250,7 @@ class TelemetrySequenceModel(nn.Module):
     #: grouped-query attention (GQA; 1 = MQA): k/v heads per block. The
     #: KV cache shrinks by heads/kv_heads (see models/decode.py)
     kv_heads: int | None = None
-    #: sliding-window attention span (flash/full backends)
+    #: sliding-window attention span (flash/full/ring backends)
     window: int | None = None
 
     @nn.compact

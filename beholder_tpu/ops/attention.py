@@ -93,24 +93,45 @@ def full_attention(
     return out if q.ndim >= 3 else out[0]
 
 
-def _block_attend(q, k, v, q_offset, kv_offset, causal):
+def _grouped(q, k):
+    """GQA group view: (..., H, t, d) q against (..., Hkv, t, d) kv ->
+    q reshaped (..., Hkv, G, t, d). G=1 is plain MHA, same path; rank-2
+    (t, d) inputs get a singleton group axis (one headless "group")."""
+    if q.ndim == 2:
+        return q[None], 1
+    hkv = k.shape[-3]
+    g = q.shape[-3] // hkv
+    return q.reshape(*q.shape[:-3], hkv, g, *q.shape[-2:]), g
+
+
+def _block_attend(q, k, v, q_offset, kv_offset, causal, window=None):
     """Scores of a local q block vs one k/v block + flash partials.
 
     Returns (m, p_sum, pv): row max, exp-sum, and exp-weighted values of
-    this block, for the online-softmax combine.
+    this block, for the online-softmax combine — all carrying the
+    grouped (..., Hkv, G, tq, ...) head layout (GQA-native: k/v may have
+    fewer heads than q; the kv block never replicates per group).
     """
     d = q.shape[-1]
-    scores = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(jnp.float32(d))
+    qg, _ = _grouped(q, k)
+    scores = jnp.einsum("...gqd,...kd->...gqk", qg, k) / jnp.sqrt(
+        jnp.float32(d)
+    )
     scores = scores.astype(jnp.float32)
     if causal:
         tq, tk = q.shape[-2], k.shape[-2]
         rows = q_offset + jnp.arange(tq)[:, None]
         cols = kv_offset + jnp.arange(tk)[None, :]
-        scores = jnp.where(rows >= cols, scores, _NEG_INF)
-    m = jnp.max(scores, axis=-1)  # (..., tq)
+        live = rows >= cols
+        if window is not None:
+            live = live & (rows - cols < window)
+        scores = jnp.where(live, scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # (..., Hkv, G, tq)
     p = jnp.exp(scores - m[..., None])
     p_sum = jnp.sum(p, axis=-1)
-    pv = jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v).astype(jnp.float32)
+    pv = jnp.einsum(
+        "...gqk,...kd->...gqd", p.astype(v.dtype), v
+    ).astype(jnp.float32)
     return m, p_sum, pv
 
 
@@ -133,6 +154,7 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "sp",
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Context-parallel attention over the ``axis`` dimension of ``mesh``.
 
@@ -146,91 +168,172 @@ def ring_attention(
     flash backward, distributed). dk/dv partial sums travel WITH their
     blocks and complete a full ring circle, arriving home with every
     device's contribution accumulated.
+
+    Grouped-query attention is native: k/v may carry fewer heads on the
+    -3 dim (H = G * Hkv) — the rotating kv blocks stay at kv-head width,
+    so GQA shrinks ring traffic by the group factor too.
+
+    ``window`` (requires ``causal``) bounds the reach: rotations stop
+    once every further block would be fully out of band, so both compute
+    AND ring communication scale with the window instead of the ring
+    size (the backward completes the gradient circle with one multi-hop
+    permutation).
     """
     p_size = mesh.shape[axis]
     t = q.shape[-2]
     if t % p_size:
         raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
-    return _ring_vjp(mesh, axis, causal, q.ndim)(q, k, v)
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    if q.ndim >= 3 and k.shape[-3] != q.shape[-3]:
+        if q.shape[-3] % k.shape[-3]:
+            raise ValueError(
+                f"GQA q heads must be a multiple of kv heads; got "
+                f"{q.shape} vs {k.shape}"
+            )
+    return _ring_vjp(mesh, axis, causal, q.ndim, window)(q, k, v)
 
 
-def _ring_local_fwd(qb, kb, vb, *, axis, p_size, block, causal, want_lse):
-    """Per-device forward: online-softmax over p_size ring rotations.
+def _ring_steps(p_size: int, block: int, causal: bool, window) -> int:
+    """Ring rotations that can ever hit live blocks. Causal sliding
+    windows bound the reach: block pair (qi, kj) is live only while
+    (qi-kj-1)*block + 1 < window, so rotations past the band carry
+    blocks that are fully masked on EVERY device — skip them entirely.
+    This makes ring comms scale with the window, not the ring size."""
+    if not causal or window is None:
+        return p_size
+    reach = 0 if window <= 1 else 1 + (window - 2) // block
+    return min(p_size, reach + 1)
+
+
+def _ring_local_fwd(
+    qb, kb, vb, *, axis, p_size, block, causal, want_lse, window=None
+):
+    """Per-device forward: online-softmax over the live ring rotations.
 
     Returns (o, lse) where lse is the per-row logsumexp the backward
-    needs to recompute probabilities exactly.
+    needs to recompute probabilities exactly. Fully masked blocks (a
+    device holding a wrapped future block, or one beyond the window) are
+    neutralized by the combine: step 0 is the device's own (live)
+    diagonal block, so the running max is finite and a -inf block max
+    scales its contribution to exactly zero.
     """
     idx = jax.lax.axis_index(axis)
     q_offset = idx * block
 
-    m = jnp.full(qb.shape[:-1], _NEG_INF, jnp.float32)
-    l = jnp.zeros(qb.shape[:-1], jnp.float32)
-    o = jnp.zeros(qb.shape, jnp.float32)
+    qg, g = _grouped(qb, kb)
+    m = jnp.full(qg.shape[:-1], _NEG_INF, jnp.float32)
+    l = jnp.zeros(qg.shape[:-1], jnp.float32)
+    o = jnp.zeros(qg.shape, jnp.float32)
     kc, vc, kv_idx = kb, vb, idx
 
-    # static unroll over the (known) ring size: p_size block attends
-    # with p_size-1 rotations — the last block needs no further hop,
-    # and XLA overlaps each ppermute with the next step's compute
+    # static unroll over the (known) live step count: the last block
+    # needs no further hop, and XLA overlaps each ppermute with the next
+    # step's compute
+    n_steps = _ring_steps(p_size, block, causal, window)
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
-    for step in range(p_size):
-        blk = _block_attend(qb, kc, vc, q_offset, kv_idx * block, causal)
+    for step in range(n_steps):
+        blk = _block_attend(
+            qb, kc, vc, q_offset, kv_idx * block, causal, window
+        )
         m, l, o = _combine((m, l, o), blk)
-        if step < p_size - 1:
+        if step < n_steps - 1:
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
             kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
 
     # under causal self-attention every row sees at least its own
-    # position, so l >= 1 always; divide directly
-    out = (o / l[..., None]).astype(qb.dtype)
+    # position, so l >= 1 always; divide directly. Non-causal visits
+    # every block, so l > 0 there too.
+    out = (o / l[..., None]).reshape(qb.shape).astype(qb.dtype)
     if not want_lse:
         return out
-    return out, m + jnp.log(jnp.maximum(l, 1e-37))
+    lse = (m + jnp.log(jnp.maximum(l, 1e-37))).reshape(
+        *qb.shape[:-1]
+    )
+    return out, lse
 
 
-def _ring_local_bwd(qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal):
+def _ring_local_bwd(
+    qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal, window=None
+):
     """Per-device flash-style backward over a second ring pass.
 
     dq accumulates locally; (dk, dv) partials rotate alongside their k/v
-    block for a FULL circle (p_size hops), so each block's gradient
-    arrives back at its owner with all devices' contributions.
+    block until every LIVE pairing has been computed, then jump the rest
+    of the circle home in ONE multi-hop ppermute — so with a sliding
+    window the gradient comms also scale with the window. GQA-native:
+    dk/dv accumulate at kv-head width (the group dim contracts in the
+    einsums); fully masked rows recompute p as exp(-inf - lse) = 0, so
+    dead (wrapped/out-of-band) blocks contribute exact zeros.
     """
     d = qb.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     idx = jax.lax.axis_index(axis)
     q_offset = idx * block
+    qg, g = _grouped(qb, kb)
     dof = dob.astype(jnp.float32)
-    delta = jnp.sum(dof * ob.astype(jnp.float32), axis=-1)  # (..., T/P)
+    delta = jnp.sum(dof * ob.astype(jnp.float32), axis=-1)  # (..., H, T/P)
+    dog = dof.reshape(qg.shape)
+    deltag = delta.reshape(qg.shape[:-1])
+    lseg = lse.reshape(qg.shape[:-1])
 
-    dq = jnp.zeros(qb.shape, jnp.float32)
+    dq = jnp.zeros(qg.shape, jnp.float32)
     kc, vc, kv_idx = kb, vb, idx
     dkc = jnp.zeros(kb.shape, jnp.float32)
     dvc = jnp.zeros(vb.shape, jnp.float32)
 
+    n_steps = _ring_steps(p_size, block, causal, window)
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
-    for step in range(p_size):
+    for step in range(n_steps):
         kv_offset = kv_idx * block
-        s = jnp.einsum("...qd,...kd->...qk", qb, kc).astype(jnp.float32) * scale
+        s = jnp.einsum("...gqd,...kd->...gqk", qg, kc).astype(
+            jnp.float32
+        ) * scale
         if causal:
             tq, tk = qb.shape[-2], kc.shape[-2]
             rows = q_offset + jnp.arange(tq)[:, None]
             cols = kv_offset + jnp.arange(tk)[None, :]
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])        # transient (T/P, T/P) block
-        dvc = dvc + jnp.einsum("...qk,...qd->...kd", p, dof)
-        dp = jnp.einsum("...qd,...kd->...qk", dof, vc.astype(jnp.float32))
-        ds = (p * (dp - delta[..., None]) * scale).astype(qb.dtype)
-        dq = dq + jnp.einsum("...qk,...kd->...qd", ds, kc).astype(jnp.float32)
-        dkc = dkc + jnp.einsum("...qk,...qd->...kd", ds.astype(jnp.float32), qb.astype(jnp.float32))
-        if step < p_size - 1:
+            live = rows >= cols
+            if window is not None:
+                live = live & (rows - cols < window)
+            s = jnp.where(live, s, _NEG_INF)
+        p = jnp.exp(s - lseg[..., None])       # transient (T/P, T/P) block
+        dvc = dvc + jnp.einsum("...gqk,...gqd->...kd", p, dog)
+        dp = jnp.einsum("...gqd,...kd->...gqk", dog, vc.astype(jnp.float32))
+        ds = (p * (dp - deltag[..., None]) * scale).astype(qb.dtype)
+        dq = dq + jnp.einsum("...gqk,...kd->...gqd", ds, kc).astype(
+            jnp.float32
+        )
+        dkc = dkc + jnp.einsum(
+            "...gqk,...gqd->...kd", ds.astype(jnp.float32),
+            qg.astype(jnp.float32),
+        )
+        if step < n_steps - 1:
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
             kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
-        # gradient partials always hop — p_size hops = full circle home
-        dkc = jax.lax.ppermute(dkc, axis, perm)
-        dvc = jax.lax.ppermute(dvc, axis, perm)
+            # gradient partials hop with their block
+            dkc = jax.lax.ppermute(dkc, axis, perm)
+            dvc = jax.lax.ppermute(dvc, axis, perm)
 
-    return dq.astype(qb.dtype), dkc.astype(kb.dtype), dvc.astype(vb.dtype)
+    # complete the circle home in ONE multi-hop permutation: the partials
+    # have hopped n_steps-1 times and need p_size total (a full ring's
+    # final hop is the shift=1 case of the same collective)
+    shift = p_size - (n_steps - 1)
+    if shift % p_size:
+        jump = [(j, (j + shift) % p_size) for j in range(p_size)]
+        dkc = jax.lax.ppermute(dkc, axis, jump)
+        dvc = jax.lax.ppermute(dvc, axis, jump)
+
+    return (
+        dq.reshape(qb.shape).astype(qb.dtype),
+        dkc.astype(kb.dtype),
+        dvc.astype(vb.dtype),
+    )
 
 
 def _lead_axes(mesh: Mesh, ndim: int) -> list:
@@ -248,8 +351,9 @@ def _lead_axes(mesh: Mesh, ndim: int) -> list:
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
-    """custom-VJP ring attention bound to (mesh, axis, causal, rank)."""
+def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int, window=None):
+    """custom-VJP ring attention bound to (mesh, axis, causal, rank,
+    window)."""
     p_size = mesh.shape[axis]
     lead = _lead_axes(mesh, ndim)
     spec = P(*lead, axis, None)
@@ -267,7 +371,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
         return shard(
             functools.partial(
                 _ring_local_fwd, axis=axis, p_size=p_size, block=block,
-                causal=causal, want_lse=False,
+                causal=causal, want_lse=False, window=window,
             ),
             (spec, spec, spec), spec,
         )(q, k, v)
@@ -277,7 +381,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
         o, lse = shard(
             functools.partial(
                 _ring_local_fwd, axis=axis, p_size=p_size, block=block,
-                causal=causal, want_lse=True,
+                causal=causal, want_lse=True, window=window,
             ),
             (spec, spec, spec), (spec, lse_spec),
         )(q, k, v)
@@ -289,7 +393,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int):
         return shard(
             functools.partial(
                 _ring_local_bwd, axis=axis, p_size=p_size, block=block,
-                causal=causal,
+                causal=causal, window=window,
             ),
             (spec, spec, spec, spec, lse_spec, spec),
             (spec, spec, spec),
