@@ -86,6 +86,9 @@ class Block(nn.Module):
     ffn: str = "dense"  # "dense" | "moe"
     num_experts: int = 4
     moe_topk: int = 1  # 1 = Switch, 2 = GShard top-2
+    #: "tokens" (Switch/GShard) or "experts" (expert-choice: perfect load
+    #: balance, no aux loss; see ops.moe — scoring workloads only)
+    moe_router: str = "tokens"
     #: shard LayerNorm/residual activations along T over tp (megatron
     #: sequence parallelism); needs ``mesh``
     seq_shard: bool = False
@@ -214,7 +217,8 @@ class Block(nn.Module):
         if self.ffn == "moe":
             x = x + SwitchFFN(
                 d, 4 * d, self.num_experts, name="moe",
-                router_topk=self.moe_topk, mesh=self.mesh,
+                router_topk=self.moe_topk, router_type=self.moe_router,
+                mesh=self.mesh,
             )(y)
         else:
             y = nn.Dense(4 * d, name="up", dtype=jnp.bfloat16)(y)
@@ -238,6 +242,8 @@ class TelemetrySequenceModel(nn.Module):
     ffn: str = "dense"  # "dense" | "moe" (Switch/GShard, ep-shardable)
     num_experts: int = 4
     moe_topk: int = 1  # 1 = Switch, 2 = GShard top-2
+    #: MoE router direction: "tokens" (Switch/GShard) or "experts"
+    moe_router: str = "tokens"
     #: rematerialize each block's activations in the backward pass
     #: (jax.checkpoint): trades one extra forward per block for O(layers)
     #: less activation memory — the standard long-context lever on TPU,
@@ -278,6 +284,7 @@ class TelemetrySequenceModel(nn.Module):
                 ffn=self.ffn,
                 num_experts=self.num_experts,
                 moe_topk=self.moe_topk,
+                moe_router=self.moe_router,
                 seq_shard=self.seq_shard,
                 kv_heads=self.kv_heads,
                 window=self.window,

@@ -194,6 +194,17 @@ def ring_attention(
                 f"GQA q heads must be a multiple of kv heads; got "
                 f"{q.shape} vs {k.shape}"
             )
+        if (
+            q.ndim >= 4
+            and "tp" in mesh.axis_names
+            and k.shape[-3] % mesh.shape["tp"]
+        ):
+            raise ValueError(
+                f"ring GQA shards kv heads over tp: kv heads "
+                f"{k.shape[-3]} must divide tp={mesh.shape['tp']} — pick "
+                f"kv_heads as a multiple of tp (or repeat kv heads before "
+                f"the call)"
+            )
     return _ring_vjp(mesh, axis, causal, q.ndim, window)(q, k, v)
 
 
@@ -224,7 +235,7 @@ def _ring_local_fwd(
     idx = jax.lax.axis_index(axis)
     q_offset = idx * block
 
-    qg, g = _grouped(qb, kb)
+    qg, _ = _grouped(qb, kb)
     m = jnp.full(qg.shape[:-1], _NEG_INF, jnp.float32)
     l = jnp.zeros(qg.shape[:-1], jnp.float32)
     o = jnp.zeros(qg.shape, jnp.float32)
@@ -274,7 +285,7 @@ def _ring_local_bwd(
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     idx = jax.lax.axis_index(axis)
     q_offset = idx * block
-    qg, g = _grouped(qb, kb)
+    qg, _ = _grouped(qb, kb)
     dof = dob.astype(jnp.float32)
     delta = jnp.sum(dof * ob.astype(jnp.float32), axis=-1)  # (..., H, T/P)
     dog = dof.reshape(qg.shape)

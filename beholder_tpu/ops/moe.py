@@ -59,6 +59,14 @@ class SwitchFFN(nn.Module):
     #: top-k routing: 1 = Switch, 2 = GShard-style top-2 (second choice
     #: queues behind every first choice in the group)
     router_topk: int = 1
+    #: "tokens" (default): tokens pick experts (Switch/GShard top-k,
+    #: capacity overflow drops). "experts": expert-choice routing (Zhou
+    #: et al. 2022) — each expert picks its top-capacity tokens, so load
+    #: balance is PERFECT by construction, no aux loss is needed, and no
+    #: capacity slot is wasted; tokens may land on 0..E experts. CAVEAT:
+    #: the top-k over the sequence lets routing see future tokens — use
+    #: for scoring/encoder workloads, not autoregressive generation.
+    router_type: str = "tokens"
     #: when set (and the mesh has ``ep_axis``), the layer follows the
     #: GShard dispatch layout: routing groups sharded over ``token_axes``,
     #: expert tensors sharded over ``ep_axis``, with sharding constraints
@@ -125,6 +133,30 @@ class SwitchFFN(nn.Module):
             xg.astype(jnp.float32)
         )
         probs = jax.nn.softmax(logits, axis=-1)  # (G, S, E)
+
+        # router z-loss (ST-MoE): keeps router logits from drifting large,
+        # which otherwise saturates the softmax and destabilizes bf16 —
+        # applies to BOTH routing directions
+        z = jax.scipy.special.logsumexp(logits, axis=-1)  # (G, S)
+        z_loss = jnp.sum(z**2 * valid[..., 0]) / n
+        self.sow("intermediates", "router_z_loss", z_loss)
+
+        if self.router_type == "experts":
+            if self.router_topk != 1:
+                raise ValueError(
+                    "router_topk is a token-choice setting; expert-choice "
+                    "capacity comes from capacity_factor alone — set "
+                    "router_topk=1 (or scale capacity_factor instead)"
+                )
+            y = self._expert_choice(
+                xg, probs, valid, on_tok, mesh_axes, tok_axes, n
+            )
+            return y.reshape(n_pad, d)[:n].reshape(b, t, d).astype(x.dtype)
+        if self.router_type != "tokens":
+            raise ValueError(
+                f"router_type must be 'tokens' or 'experts', got "
+                f"{self.router_type!r}"
+            )
         gate = jnp.max(probs, axis=-1)  # (G, S)
         choice = jnp.argmax(probs, axis=-1)  # (G, S)
         onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32) * valid  # (G, S, E)
@@ -166,55 +198,16 @@ class SwitchFFN(nn.Module):
         else:
             raise ValueError(f"router_topk must be 1 or 2, got {self.router_topk}")
 
-        w_up = self.param(
-            "expert_up", nn.initializers.lecun_normal(), (e, d, self.ff_dim)
-        )
-        b_up = self.param("expert_up_bias", nn.initializers.zeros, (e, self.ff_dim))
-        w_down = self.param(
-            "expert_down", nn.initializers.lecun_normal(), (e, self.ff_dim, d)
-        )
-        b_down = self.param("expert_down_bias", nn.initializers.zeros, (e, d))
-
-        def on_ep(arr):
-            """Expert dim (axis 1) pinned onto the ep mesh axis; the group
-            dim keeps any token axes that are NOT the ep axis (dp rows).
-            The transition from on_tok to on_ep layout IS the token
-            exchange — GSPMD lowers it to an all-to-all over ep."""
-            if self.mesh is not None and self.ep_axis in mesh_axes:
-                from jax.sharding import NamedSharding
-
-                g_axes = tuple(a for a in tok_axes if a != self.ep_axis)
-                spec = P(
-                    g_axes if g_axes else None,
-                    self.ep_axis,
-                    *([None] * (arr.ndim - 2)),
-                )
-                return jax.lax.with_sharding_constraint(
-                    arr, NamedSharding(self.mesh, spec)
-                )
-            return arr
-
         # dispatch locally on each group shard FIRST (on_tok), then
         # reshard to the expert layout (on_ep): the double constraint
         # keeps GSPMD from fusing the layout change into the einsum
         # (which would all-gather the inputs) — the reshard itself is
         # the token exchange, lowered to an all-to-all over ep
+        on_ep = self._on_ep(mesh_axes, tok_axes)
         xin = on_ep(
             on_tok(jnp.einsum("gsec,gsd->gecd", dispatch, xg.astype(jnp.float32)))
         )
-        h = on_ep(
-            jnp.einsum(
-                "gecd,edf->gecf", xin.astype(jnp.bfloat16), w_up.astype(jnp.bfloat16)
-            ).astype(jnp.float32)
-            + b_up[None, :, None, :]
-        )
-        h = jax.nn.gelu(h)
-        out = on_ep(
-            jnp.einsum(
-                "gecf,efd->gecd", h.astype(jnp.bfloat16), w_down.astype(jnp.bfloat16)
-            ).astype(jnp.float32)
-            + b_down[None, :, None, :]
-        )
+        out = self._expert_mlp(xin, on_ep)
         y = on_tok(jnp.einsum("gsec,gecd->gsd", combine, out))
 
         # Switch load-balance loss: E * sum_e f_e * p_e, minimized (=1) at
@@ -231,12 +224,6 @@ class SwitchFFN(nn.Module):
         aux = e * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "aux_loss", aux)
 
-        # router z-loss (ST-MoE): keeps router logits from drifting large,
-        # which otherwise saturates the softmax and destabilizes bf16
-        z = jax.scipy.special.logsumexp(logits, axis=-1)  # (G, S)
-        z_loss = jnp.sum(z**2 * valid[..., 0]) / n
-        self.sow("intermediates", "router_z_loss", z_loss)
-
         # dropped-token fraction: a METRIC, not a loss term (seq_loss
         # skips it) — capacity overflow is silent otherwise. Each real
         # token owes router_topk assignments; count how many landed.
@@ -245,6 +232,97 @@ class SwitchFFN(nn.Module):
         self.sow("intermediates", "drop_fraction", drop_frac)
 
         return y.reshape(n_pad, d)[:n].reshape(b, t, d).astype(x.dtype)
+
+    def _on_ep(self, mesh_axes, tok_axes):
+        """Expert dim (axis 1) pinned onto the ep mesh axis; the group
+        dim keeps any token axes that are NOT the ep axis (dp rows).
+        The transition from on_tok to on_ep layout IS the token
+        exchange — GSPMD lowers it to an all-to-all over ep."""
+
+        def on_ep(arr):
+            if self.mesh is not None and self.ep_axis in mesh_axes:
+                from jax.sharding import NamedSharding
+
+                g_axes = tuple(a for a in tok_axes if a != self.ep_axis)
+                spec = P(
+                    g_axes if g_axes else None,
+                    self.ep_axis,
+                    *([None] * (arr.ndim - 2)),
+                )
+                return jax.lax.with_sharding_constraint(
+                    arr, NamedSharding(self.mesh, spec)
+                )
+            return arr
+
+        return on_ep
+
+    def _expert_mlp(self, xin, on_ep):
+        """(G, E, C, D) dispatched tokens -> (G, E, C, D) expert outputs;
+        bf16 matmuls against the ep-sharded expert stacks."""
+        e, d = self.num_experts, self.dim
+        w_up = self.param(
+            "expert_up", nn.initializers.lecun_normal(), (e, d, self.ff_dim)
+        )
+        b_up = self.param(
+            "expert_up_bias", nn.initializers.zeros, (e, self.ff_dim)
+        )
+        w_down = self.param(
+            "expert_down", nn.initializers.lecun_normal(), (e, self.ff_dim, d)
+        )
+        b_down = self.param("expert_down_bias", nn.initializers.zeros, (e, d))
+        h = on_ep(
+            jnp.einsum(
+                "gecd,edf->gecf", xin.astype(jnp.bfloat16),
+                w_up.astype(jnp.bfloat16),
+            ).astype(jnp.float32)
+            + b_up[None, :, None, :]
+        )
+        h = jax.nn.gelu(h)
+        return on_ep(
+            jnp.einsum(
+                "gecf,efd->gecd", h.astype(jnp.bfloat16),
+                w_down.astype(jnp.bfloat16),
+            ).astype(jnp.float32)
+            + b_down[None, :, None, :]
+        )
+
+    def _expert_choice(
+        self, xg, probs, valid, on_tok, mesh_axes, tok_axes, n
+    ):
+        """Expert-choice routing: each expert top-k's its tokens.
+
+        Dispatch is (G, E, C, S) — expert e's slot c holds its c-th best
+        token — so every capacity slot is filled and per-expert load is
+        exactly C by construction: no aux loss, no overflow drops. The
+        expert pipeline and the ep all-to-all layout are identical to the
+        token-choice path; only the selection direction differs.
+        """
+        _, s, e = probs.shape
+        cap = min(s, max(1, int(self.capacity_factor * s / e)))
+        # padding rows never get picked while any real token remains:
+        # their selection score is forced below every real softmax prob
+        scores = jnp.where(valid > 0, probs, -1.0)
+        _, idx = jax.lax.top_k(jnp.swapaxes(scores, 1, 2), cap)  # (G,E,C)
+        dispatch = jax.nn.one_hot(idx, s, dtype=jnp.float32)     # (G,E,C,S)
+        # combine weight of slot (e, c) = its token's affinity for e
+        # (padding-picked slots get 0 and contribute nothing)
+        gv = jnp.einsum("gecs,gse->gec", dispatch, probs * valid)
+
+        on_ep = self._on_ep(mesh_axes, tok_axes)
+        xin = on_ep(
+            on_tok(
+                jnp.einsum("gecs,gsd->gecd", dispatch, xg.astype(jnp.float32))
+            )
+        )
+        out = self._expert_mlp(xin, on_ep)
+        y = on_tok(jnp.einsum("gecs,gec,gecd->gsd", dispatch, gv, out))
+
+        # no aux loss — load balance is structural. The health metric
+        # flips: how many REAL tokens were picked by no expert at all?
+        picked = jnp.clip(jnp.einsum("gecs->gs", dispatch), 0.0, 1.0)
+        unrouted = 1.0 - jnp.sum(picked * valid[..., 0]) / n
+        self.sow("intermediates", "unrouted_fraction", unrouted)
+        return y
 
 
 def moe_metrics(sown: Any) -> dict[str, float]:
@@ -255,7 +333,7 @@ def moe_metrics(sown: Any) -> dict[str, float]:
     sums: dict[str, list] = {}
     for path, leaf in tree_flatten_with_path(sown)[0]:
         names = path_key_names(path)
-        for key in ("drop_fraction", "aux_loss", "router_z_loss"):
+        for key in ("drop_fraction", "aux_loss", "router_z_loss", "unrouted_fraction"):
             if key in names:
                 sums.setdefault(key, []).append(leaf)
     return {k: float(sum(v) / len(v)) for k, v in sums.items()}
