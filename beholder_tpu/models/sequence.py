@@ -98,9 +98,8 @@ class Block(nn.Module):
     #: holds whole kv heads.
     kv_heads: int | None = None
     #: sliding-window attention: each position attends only the previous
-    #: ``window`` positions (flash/full/ring backends; the packed banded
-    #: kernel grid — and ring's bounded rotations — make cost scale with
-    #: T * window)
+    #: ``window`` positions (all backends; the packed banded kernel grid —
+    #: and ring's bounded rotations — make cost scale with T * window)
     window: int | None = None
 
     @nn.compact
@@ -184,24 +183,19 @@ class Block(nn.Module):
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")
-            if self.window is not None and self.attention == "ulysses":
-                raise ValueError(
-                    "window is supported by the flash/full/ring backends, "
-                    "not 'ulysses'"
-                )
             kv_out = (k, v)  # cache k/v keep their hkv heads
-            if self.attention == "ulysses" and hkv != h:
-                # Ulysses' all-to-all splits the HEAD dim over sp, so kv
-                # groups broadcast up front; ring and flash are GQA-native
-                # (ring even shrinks its rotating blocks by the group)
-                k = jnp.repeat(k, h // hkv, axis=1)
-                v = jnp.repeat(v, h // hkv, axis=1)
             if self.attention == "ring":
                 att = ring_attention(
                     q, k, v, self.mesh, causal=True, window=self.window
                 )
             elif self.attention == "ulysses":
-                att = ulysses_attention(q, k, v, self.mesh, causal=True)
+                # GQA-native: the kv all-to-all runs at kv-head width when
+                # sp divides the per-tp-shard kv head count
+                # (ulysses_attention broadcasts groups itself otherwise);
+                # window rides the local banded grid
+                att = ulysses_attention(
+                    q, k, v, self.mesh, causal=True, window=self.window
+                )
             elif self.attention == "flash":
                 att = flash_attention(q, k, v, causal=True, window=self.window)
             else:
@@ -256,7 +250,7 @@ class TelemetrySequenceModel(nn.Module):
     #: grouped-query attention (GQA; 1 = MQA): k/v heads per block. The
     #: KV cache shrinks by heads/kv_heads (see models/decode.py)
     kv_heads: int | None = None
-    #: sliding-window attention span (flash/full/ring backends)
+    #: sliding-window attention span (any attention backend)
     window: int | None = None
 
     @nn.compact

@@ -201,9 +201,9 @@ def ring_attention(
         ):
             raise ValueError(
                 f"ring GQA shards kv heads over tp: kv heads "
-                f"{k.shape[-3]} must divide tp={mesh.shape['tp']} — pick "
-                f"kv_heads as a multiple of tp (or repeat kv heads before "
-                f"the call)"
+                f"{k.shape[-3]} must be divisible by tp="
+                f"{mesh.shape['tp']} — pick kv_heads as a multiple of tp "
+                f"(or repeat kv heads before the call)"
             )
     return _ring_vjp(mesh, axis, causal, q.ndim, window)(q, k, v)
 
@@ -422,6 +422,7 @@ def ulysses_attention(
     axis: str = "sp",
     causal: bool = False,
     backend: str = "flash",
+    window: int | None = None,
 ) -> jax.Array:
     """DeepSpeed-Ulysses sequence parallelism: all-to-all head scatter.
 
@@ -433,18 +434,48 @@ def ulysses_attention(
     exchange. Two all-to-alls per call vs ring attention's P-1 ppermutes;
     the tradeoff is H % P == 0 and O(T) k/v memory per device (vs ring's
     O(T/P)), which buys much better compute locality for moderate T.
+
+    Grouped-query attention: k/v may carry fewer heads (Hkv) than q. When
+    the per-tp-shard kv head count (Hkv, or Hkv/tp on a tp mesh) is
+    divisible by the axis size, the kv all-to-all runs at kv-head width —
+    GQA's traffic saving survives the exchange (flash and full are
+    GQA-native on whole-sequence heads). Otherwise kv heads broadcast to
+    H first (the pre-round-4 fallback; also when Hkv can't shard over tp
+    at all). ``window`` (requires ``causal``) is the sliding-window span,
+    handled by the local backend's banded grid once each device holds
+    whole sequences.
     """
     p_size = mesh.shape[axis]
     b, h, t, d = q.shape
+    hkv = k.shape[1]
+    if h % hkv:
+        raise ValueError(
+            f"GQA q heads must be a multiple of kv heads; got {h} vs {hkv}"
+        )
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     # heads local to one device after any tp (megatron column) sharding:
     # the all-to-all splits THAT dim, so it must divide by sp
-    h_local = h // mesh.shape.get("tp", 1) if "tp" in mesh.axis_names else h
+    tp = mesh.shape.get("tp", 1) if "tp" in mesh.axis_names else 1
+    h_local = h // tp
     if h_local % p_size:
         raise ValueError(
             f"per-device heads {h_local} not divisible by {axis}={p_size}"
         )
     if t % p_size:
         raise ValueError(f"sequence length {t} not divisible by {axis}={p_size}")
+    if hkv % tp:
+        # kv heads can't shard over tp at all: broadcast to full head
+        # width BEFORE shard_map (the in_specs put tp on the head dim, so
+        # a late repeat inside the body would be too late)
+        k = jnp.repeat(k, h // hkv, axis=1)
+        v = jnp.repeat(v, h // hkv, axis=1)
+        hkv = h
+    # kv all-to-all stays at kv-head width only if the LOCAL (per-tp-
+    # shard) kv heads split evenly over sp; otherwise broadcast groups
+    # inside the body (group boundaries stay shard-aligned since
+    # hkv % tp == 0 here)
+    kv_native = (hkv // tp) % p_size == 0
 
     if backend == "flash":
         from beholder_tpu.ops.flash_attention import flash_attention as attend
@@ -453,11 +484,14 @@ def ulysses_attention(
 
     def local(qb, kb, vb):
         # (B, H, T/P, d) -> (B, H/P, T, d): split heads, gather sequence
+        if not kv_native:
+            kb = jnp.repeat(kb, h // hkv, axis=1)
+            vb = jnp.repeat(vb, h // hkv, axis=1)
         qh, kh, vh = (
             jax.lax.all_to_all(a, axis, split_axis=1, concat_axis=2, tiled=True)
             for a in (qb, kb, vb)
         )
-        att = attend(qh, kh, vh, causal=causal)
+        att = attend(qh, kh, vh, causal=causal, window=window)
         # (B, H/P, T, d) -> (B, H, T/P, d)
         return jax.lax.all_to_all(att, axis, split_axis=2, concat_axis=1, tiled=True)
 

@@ -38,6 +38,42 @@ N_MESSAGES = 60_000
 WARMUP = 2_000
 TRIALS = 5
 
+# Host-speed anchor: the same fixed pure-Python workload is timed in-run
+# and the headline figure is normalized by (this constant / measured
+# anchor). The constant is the anchor rate on the round-4 host (measured
+# 1.284-1.301M over repeated runs, ~1% spread), so ``normalized`` is
+# "msg/s this code would do on the round-4 host" — comparable across
+# rounds while the raw value keeps moving with whatever machine the
+# driver lands on (see BENCH_NOTES.md: a 2.3x cross-round host swing).
+ANCHOR_REF_OPS = 1_293_000
+
+
+def _host_anchor() -> float:
+    """Fixed interpreter-bound calibration workload (ops/s): dict writes,
+    string formatting, int arithmetic — the same cost profile as the
+    service hot path (which is interpreted Python end to end). Pure CPU,
+    zero I/O, deterministic op count."""
+
+    def work(n: int):
+        acc = 0
+        d: dict = {}
+        s: list = []
+        for i in range(n):
+            key = i & 63
+            d[key] = ("m%d" % key, i, acc & 1023)
+            acc += (i ^ (i >> 3)) + len(d)
+            if key == 0:
+                s.append(acc)
+        return acc, len(s)
+
+    work(20_000)  # warm the code object
+    best = 0.0
+    for _ in range(3):
+        start = time.perf_counter()
+        work(200_000)
+        best = max(best, 200_000 / (time.perf_counter() - start))
+    return best
+
 
 class NullTransport(HttpTransport):
     """Formats/serializes like the real path but skips the socket."""
@@ -121,6 +157,7 @@ def bench_service() -> dict:
     best plus the spread; best-of is the standard estimator for
     interference-limited microbenchmarks (min ≈ true cost, tail = noise).
     """
+    anchor = _host_anchor()
     rates = []
     for _ in range(TRIALS):
         service, broker, transport = build_service()
@@ -134,10 +171,15 @@ def bench_service() -> dict:
         assert broker.in_flight == 0, "benchmark messages must all be acked"
         assert transport.count > 0
         rates.append(N_MESSAGES / elapsed)
+    best = max(rates)
     return {
-        "value": round(max(rates), 1),
+        "value": round(best, 1),
         "trials": [round(r, 1) for r in rates],
-        "spread_pct": round(100 * (max(rates) - min(rates)) / max(rates), 1),
+        "spread_pct": round(100 * (best - min(rates)) / best, 1),
+        "host_anchor_ops": round(anchor),
+        # best msg/s rescaled to the round-4 reference host's speed: the
+        # cross-round comparable figure (raw value tracks host drift)
+        "normalized": round(best * ANCHOR_REF_OPS / anchor, 1),
     }
 
 
@@ -602,6 +644,8 @@ def main() -> None:
                 "unit": "msg/s",
                 "trials": svc["trials"],
                 "spread_pct": svc["spread_pct"],
+                "host_anchor_ops": svc["host_anchor_ops"],
+                "normalized": svc["normalized"],
                 "vs_baseline": 1.0,
                 "note": (
                     "reference publishes no benchmark numbers "

@@ -108,6 +108,99 @@ def test_ulysses_rejects_indivisible_heads(sp_mesh):
         ulysses_attention(q, k, v, sp_mesh)
 
 
+def test_ulysses_window_matches_full(sp_mesh):
+    """Sliding windows ride the local flash banded grid after the head
+    scatter (closes the round-3 'no ulysses window' gap)."""
+    q, k, v = _qkv(14, b=1, h=8, t=256, d=16)
+    want = full_attention(q, k, v, causal=True, window=48)
+    got = ulysses_attention(q, k, v, sp_mesh, causal=True, window=48)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="causal"):
+        ulysses_attention(q, k, v, sp_mesh, window=48)
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in eqn.params.values():
+            if hasattr(sub, "eqns"):  # raw Jaxpr (shard_map stores one)
+                yield from _walk_eqns(sub)
+            elif hasattr(sub, "jaxpr"):  # ClosedJaxpr
+                yield from _walk_eqns(sub.jaxpr)
+
+
+def test_ulysses_gqa_native_kv_width(sp_mesh):
+    """With kv heads divisible by sp the kv all-to-all runs at KV-head
+    width — GQA's traffic saving survives the exchange (round-3 weak #6:
+    the old path repeat-broadcast kv to full head width first)."""
+    b, h, hkv, t, d = 1, 16, 8, 128, 8
+    keys = jax.random.split(jax.random.PRNGKey(15), 3)
+    q = jax.random.normal(keys[0], (b, h, t, d), jnp.float32)
+    k = jax.random.normal(keys[1], (b, hkv, t, d), jnp.float32)
+    v = jax.random.normal(keys[2], (b, hkv, t, d), jnp.float32)
+    want = full_attention(q, k, v, causal=True)
+    fn = lambda q, k, v: ulysses_attention(q, k, v, sp_mesh, causal=True)
+    got = fn(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+    # proof of width: the kv exchanges' INPUTS carry hkv heads (the
+    # repeat fallback would feed all-to-all at h=16-head width)
+    jaxpr = jax.make_jaxpr(fn)(q, k, v)
+    a2a_head_widths = [
+        eqn.invars[0].aval.shape[1]
+        for eqn in _walk_eqns(jaxpr.jaxpr)
+        if eqn.primitive.name == "all_to_all"
+        and len(eqn.invars[0].aval.shape) == 4
+        # pre-scatter inputs are local (B, H?, T/P, d) sequence shards
+        and eqn.invars[0].aval.shape[-2] == t // 8
+    ]
+    assert a2a_head_widths.count(hkv) == 2, a2a_head_widths  # k and v
+
+    # the fallback (hkv=2 not divisible by sp=8) still matches full
+    k2, v2 = k[:, :2], v[:, :2]
+    want2 = full_attention(q, k2, v2, causal=True)
+    got2 = ulysses_attention(q, k2, v2, sp_mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got2), np.asarray(want2), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_ulysses_mqa_on_tp_mesh_broadcasts_up_front():
+    """kv heads that can't shard over tp (MQA, hkv=1, tp=2) broadcast to
+    full head width BEFORE shard_map — a late in-body repeat can't fix
+    the in_specs' head-dim sharding (round-4 review regression)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("tp", "sp"))
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = jax.random.normal(ks[0], (1, 8, 64, 8))
+    k = jax.random.normal(ks[1], (1, 1, 64, 8))
+    v = jax.random.normal(ks[2], (1, 1, 64, 8))
+    got = ulysses_attention(q, k, v, mesh, causal=True, window=16)
+    want = full_attention(q, k, v, causal=True, window=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_gqa_window_gradients_match_full(sp_mesh):
+    b, h, hkv, t, d = 1, 16, 8, 128, 8
+    keys = jax.random.split(jax.random.PRNGKey(16), 3)
+    q = jax.random.normal(keys[0], (b, h, t, d), jnp.float32)
+    k = jax.random.normal(keys[1], (b, hkv, t, d), jnp.float32)
+    v = jax.random.normal(keys[2], (b, hkv, t, d), jnp.float32)
+
+    def loss(fn):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    want = loss(lambda q, k, v: full_attention(q, k, v, causal=True, window=32))
+    got = loss(
+        lambda q, k, v: ulysses_attention(
+            q, k, v, sp_mesh, causal=True, window=32
+        )
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-3, atol=1e-4)
+
+
 def test_ulysses_gradients_flow(sp_mesh):
     """A ulysses training step differentiates through both all-to-alls."""
     q, k, v = _qkv(7, b=1, h=8, t=64, d=8)
