@@ -13,11 +13,19 @@ proto:
 	$(PROTOC) --python_out=beholder_tpu/proto -I beholder_tpu/proto \
 		beholder_tpu/proto/api.proto
 
-native: native/build/libframecodec.so
+native: native/build/libframecodec.so native/build/framecodec_ext.so
 
 native/build/libframecodec.so: native/framecodec.cc
 	mkdir -p native/build
 	$(CXX) -O2 -Wall -Wextra -shared -fPIC -o $@ $<
+
+# CPython C-API binding (zero ctypes marshaling overhead; see
+# native/framecodec_pymod.cc). Python.h location comes from sysconfig.
+native/build/framecodec_ext.so: native/framecodec_pymod.cc
+	mkdir -p native/build
+	$(CXX) -O2 -Wall -Wextra -shared -fPIC \
+		-I$$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])") \
+		-o $@ $<
 
 test:
 	python -m pytest tests/ -q

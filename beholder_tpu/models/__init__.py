@@ -32,8 +32,10 @@ from .serving import (
     Request,
     init_paged,
     paged_admit,
+    paged_admit_batch,
     paged_decode_tick,
     paged_release,
+    paged_wave,
 )
 
 __all__ = [
@@ -42,8 +44,10 @@ __all__ = [
     "Request",
     "init_paged",
     "paged_admit",
+    "paged_admit_batch",
     "paged_decode_tick",
     "paged_release",
+    "paged_wave",
     "decode_step",
     "forecast_deltas",
     "forecast_eta",

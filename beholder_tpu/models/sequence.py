@@ -40,10 +40,37 @@ from beholder_tpu.ops.attention import (
 )
 from beholder_tpu.ops.flash_attention import flash_attention
 from beholder_tpu.ops.moe import SwitchFFN
+from beholder_tpu.ops.paged_attention import (
+    PagedInfo,
+    QuantizedPool,
+    paged_decode_attention,
+)
 
 from .train import TrainState, apply_gradients
 
 FEATURES = 1 + NUM_STATUSES
+
+
+def _pool_write_column(pool, info: PagedInfo, col: jax.Array):
+    """Scatter each slot's new (Hkv, Dh) kv column into its write page
+    at its write offset — (tokens-on-lanes pool layout, so the column
+    lands on one lane). Out-of-bounds page ids (inactive slots) drop.
+    Int8 pools quantize the column per (head, token) on the way in."""
+    if isinstance(pool, QuantizedPool):
+        from beholder_tpu.ops.quant import quantize_symmetric
+
+        q, scale = quantize_symmetric(col, axis=-1)  # scale (S, Hkv)
+        return QuantizedPool(
+            pool.values.at[info.write_pages, :, :, info.write_offsets].set(
+                q, mode="drop"
+            ),
+            pool.scales.at[info.write_pages, :, info.write_offsets].set(
+                scale, mode="drop"
+            ),
+        )
+    return pool.at[info.write_pages, :, :, info.write_offsets].set(
+        col.astype(pool.dtype), mode="drop"
+    )
 
 
 def _seq_shard_constraint(mesh: Mesh | None, x: jax.Array) -> jax.Array:
@@ -130,56 +157,79 @@ class Block(nn.Module):
         )
         if cache is not None:
             k_cache, v_cache, index = cache
-            if getattr(index, "ndim", 0) == 1:
-                # per-sequence positions (continuous batching: each slot
-                # sits at its own length) — scatter one column per batch
-                # row; t must be 1 on this path
-                rows = jnp.arange(b)
-                k_cache = k_cache.at[rows, :, index, :].set(
-                    k[:, :, 0, :].astype(k_cache.dtype)
-                )
-                v_cache = v_cache.at[rows, :, index, :].set(
-                    v[:, :, 0, :].astype(v_cache.dtype)
-                )
+            if isinstance(index, PagedInfo):
+                # paged serving: scatter the new kv column into this
+                # slot's page (OOB page ids drop — inactive slots), then
+                # attend the slot's pages IN PLACE via the page table
+                # inside the Pallas decode kernel. t must be 1 here;
+                # execution falls through to the shared proj/FFN tail.
+                k_cache = _pool_write_column(k_cache, index, k[:, :, 0, :])
+                v_cache = _pool_write_column(v_cache, index, v[:, :, 0, :])
+                quant = isinstance(k_cache, QuantizedPool)
+                att = paged_decode_attention(
+                    q[:, :, 0, :],
+                    k_cache.values if quant else k_cache,
+                    v_cache.values if quant else v_cache,
+                    index.page_table,
+                    index.lens,
+                    window=self.window,
+                    k_scale=k_cache.scales if quant else None,
+                    v_scale=v_cache.scales if quant else None,
+                )[:, :, None, :]                         # (S, H, 1, Dh)
+                kv_out = (k_cache, v_cache)
             else:
-                k_cache = jax.lax.dynamic_update_slice(
-                    k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
-                )
-                v_cache = jax.lax.dynamic_update_slice(
-                    v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
-                )
-            # Same dtype mix as ops.attention.full_attention (the training
-            # forward): score matmul in the cache dtype (bf16 on the MXU),
-            # f32 softmax, weights cast back before the PV matmul — so
-            # incremental decode reproduces the full causal forward bit-for
-            # -bit up to accumulation order. The group dim g = H/Hkv makes
-            # every q head in a group read its shared kv-cache head (g=1
-            # degenerates to plain MHA).
-            g = h // hkv
-            qg = q.astype(k_cache.dtype).reshape(b, hkv, g, t, dh)
-            scores = jnp.einsum(
-                "bhgqd,bhkd->bhgqk", qg, k_cache
-            ) / jnp.sqrt(jnp.float32(dh))
-            positions = jnp.arange(k_cache.shape[2])
-            if getattr(index, "ndim", 0) == 1:
-                live = positions[None, :] <= index[:, None]     # (B, L)
-                if self.window is not None:
-                    live = live & (
-                        positions[None, :] > index[:, None] - self.window
+                if getattr(index, "ndim", 0) == 1:
+                    # per-sequence positions (continuous batching: each
+                    # slot sits at its own length) — scatter one column
+                    # per batch row; t must be 1 on this path
+                    rows = jnp.arange(b)
+                    k_cache = k_cache.at[rows, :, index, :].set(
+                        k[:, :, 0, :].astype(k_cache.dtype)
                     )
-                live = live[:, None, None, None, :]
-            else:
-                live = positions <= index
-                if self.window is not None:
-                    # decode position ``index`` sees the previous
-                    # ``window`` cache slots, matching the training band
-                    live = live & (positions > index - self.window)
-            scores = jnp.where(live, scores, -1e30)
-            weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-            att = jnp.einsum(
-                "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), v_cache
-            ).reshape(b, h, t, dh)
-            kv_out = (k_cache, v_cache)
+                    v_cache = v_cache.at[rows, :, index, :].set(
+                        v[:, :, 0, :].astype(v_cache.dtype)
+                    )
+                else:
+                    k_cache = jax.lax.dynamic_update_slice(
+                        k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
+                    )
+                    v_cache = jax.lax.dynamic_update_slice(
+                        v_cache, v.astype(v_cache.dtype), (0, 0, index, 0)
+                    )
+                # Same dtype mix as ops.attention.full_attention (the
+                # training forward): score matmul in the cache dtype
+                # (bf16 on the MXU), f32 softmax, weights cast back
+                # before the PV matmul — so incremental decode reproduces
+                # the full causal forward bit-for-bit up to accumulation
+                # order. The group dim g = H/Hkv makes every q head in a
+                # group read its shared kv-cache head (g=1 degenerates to
+                # plain MHA).
+                g = h // hkv
+                qg = q.astype(k_cache.dtype).reshape(b, hkv, g, t, dh)
+                scores = jnp.einsum(
+                    "bhgqd,bhkd->bhgqk", qg, k_cache
+                ) / jnp.sqrt(jnp.float32(dh))
+                positions = jnp.arange(k_cache.shape[2])
+                if getattr(index, "ndim", 0) == 1:
+                    live = positions[None, :] <= index[:, None]  # (B, L)
+                    if self.window is not None:
+                        live = live & (
+                            positions[None, :] > index[:, None] - self.window
+                        )
+                    live = live[:, None, None, None, :]
+                else:
+                    live = positions <= index
+                    if self.window is not None:
+                        # decode position ``index`` sees the previous
+                        # ``window`` cache slots, matching the training
+                        # band
+                        live = live & (positions > index - self.window)
+                scores = jnp.where(live, scores, -1e30)
+                weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+                att = jnp.einsum(
+                    "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), v_cache
+                ).reshape(b, h, t, dh)
+                kv_out = (k_cache, v_cache)
         else:
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")

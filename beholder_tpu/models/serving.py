@@ -6,25 +6,34 @@ with one dense (B, Hkv, max_len, Dh) cache per layer; this module serves
 a CHANGING population of requests the way modern LLM servers do
 (vLLM-style), re-thought for XLA's static-shape compilation model:
 
-- **Paged pool.** Each layer's cache is a (num_pages, Hkv, page_size,
-  Dh) pool; a sequence owns a list of pages (``page_table`` row). Memory
-  scales with TOKENS IN FLIGHT, not slots x max_len: short and long
-  requests share the pool, and a retiring request returns its pages to a
-  free stack for the next admit.
-- **Static shapes everywhere.** The decode tick is ONE compiled program
-  for all slots: gather each slot's pages into a transient view
-  (XLA gather), run the model's cached decode with PER-SLOT positions
-  (each slot sits at its own length — the vector-index cache path in
-  :class:`~beholder_tpu.models.sequence.Block`), scatter the new kv
-  column back into the pool. Admission and retirement are also fixed
-  shape: page allocation is a masked vectorized stack pop, freeing a
-  masked push — no data-dependent Python in jit.
-- **Continuous batching.** The host-side :class:`ContinuousBatcher`
-  admits queued requests into free slots mid-flight, ticks all active
-  slots together, and retires finished ones — the accelerator never
-  waits for the longest request in a "static batch" to finish. The only
-  host<->device traffic per tick is the (slots,) predictions readback
-  that the batcher feeds back as the next inputs.
+- **Paged pool.** Each layer's cache is a (num_pages, Hkv, Dh, page)
+  pool — tokens on the minor (lane) dim, the TPU-native page layout (see
+  :mod:`beholder_tpu.ops.paged_attention`); a sequence owns a list of
+  pages (``page_table`` row). Memory scales with TOKENS IN FLIGHT, not
+  slots x max_len; a retiring request returns its pages to a free stack.
+- **Paged at COMPUTE time too.** The decode tick scatters each slot's
+  new kv column into its page and then attends the pages IN PLACE via
+  the scalar-prefetched page table inside a Pallas kernel
+  (:func:`~beholder_tpu.ops.paged_attention.paged_decode_attention`) —
+  no dense (slots, max_pages*page) view of the cache ever materializes
+  (round 3 gathered one per layer per tick; pinned gone by
+  ``tests/test_serving.py::test_tick_never_materializes_dense_views``).
+- **Static shapes everywhere.** The tick is ONE compiled program for all
+  slots; admission and retirement are fixed shape too: page allocation
+  is a masked vectorized stack pop, freeing a masked push — no
+  data-dependent Python in jit.
+- **Int8 KV cache** (``cache_dtype="int8"``): pages are stored int8 with
+  per-(token, head) scales, dequantized inside the decode kernel — the
+  cache's HBM footprint AND the tick's page traffic halve vs bf16,
+  composing with GQA's kv-head shrink (same lever stack as vLLM + the
+  weight-only quant in :mod:`beholder_tpu.ops.quant`).
+- **Continuous batching, two ways.** :meth:`ContinuousBatcher.run` is
+  the flexible scheduler: admit queued requests into free slots
+  mid-flight, tick all active slots together, retire finished ones. For
+  fixed-horizon fleets :meth:`ContinuousBatcher.run_waves` fuses
+  admit -> scan(ticks) -> retire into compiled code — the prediction
+  feedback loop stays ON DEVICE inside one ``lax.scan`` (no per-token
+  host round-trip, the round-3 latency wall).
 
 The paged decode is numerically equivalent to the dense per-request
 rollout (pinned by ``tests/test_serving.py``).
@@ -39,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
+from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
 
 from .sequence import TelemetrySequenceModel
 
@@ -46,7 +56,10 @@ from .sequence import TelemetrySequenceModel
 class PagedKVState(NamedTuple):
     """Paged serving state (a pytree; every leaf has a static shape).
 
-    - ``k_pools``/``v_pools``: per-layer (num_pages, Hkv, page, Dh)
+    - ``k_pools``/``v_pools``: per-layer (num_pages, Hkv, Dh, page)
+      arrays, or :class:`~beholder_tpu.ops.paged_attention.QuantizedPool`
+      (int8 values + (num_pages, Hkv, page) f32 scales) under int8
+      caching
     - ``page_table``: (slots, max_pages) pool indices per slot
     - ``seq_lens``: (slots,) tokens written per slot
     - ``active``: (slots,) bool
@@ -72,15 +85,23 @@ def init_paged(
     page_size: int,
     slots: int,
     max_pages_per_seq: int,
+    cache_dtype=jnp.bfloat16,
 ) -> PagedKVState:
     dh = model.dim // model.heads
     hkv = model.kv_heads or model.heads
-    shape = (num_pages, hkv, page_size, dh)
-    k_pools = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
-    v_pools = tuple(jnp.zeros(shape, jnp.bfloat16) for _ in range(model.layers))
+    shape = (num_pages, hkv, dh, page_size)
+    if cache_dtype in (jnp.int8, "int8"):
+        def pool():
+            return QuantizedPool(
+                jnp.zeros(shape, jnp.int8),
+                jnp.ones((num_pages, hkv, page_size), jnp.float32),
+            )
+    else:
+        def pool():
+            return jnp.zeros(shape, cache_dtype)
     return PagedKVState(
-        k_pools,
-        v_pools,
+        tuple(pool() for _ in range(model.layers)),
+        tuple(pool() for _ in range(model.layers)),
         jnp.zeros((slots, max_pages_per_seq), jnp.int32),
         jnp.zeros((slots,), jnp.int32),
         jnp.zeros((slots,), bool),
@@ -90,10 +111,17 @@ def init_paged(
     )
 
 
+def _pool_geometry(state: PagedKVState) -> tuple[int, int]:
+    """(num_pages, page_size) of the state's pools (quantized or not)."""
+    p0 = state.k_pools[0]
+    vals = p0.values if isinstance(p0, QuantizedPool) else p0
+    return vals.shape[0], vals.shape[3]
+
+
 def _pop_pages(state: PagedKVState, need: jax.Array):
-    """Vectorized masked stack pop: slot i with ``need[i]`` gets page
+    """Vectorized masked stack pop: needer i (with ``need[i]``) gets page
     ``free_stack[free_top - 1 - rank_i]`` where rank_i numbers the
-    needers. Returns (pages (slots,), new_top, failed)."""
+    needers. Returns (pages (len(need),), new_top, failed)."""
     rank = jnp.cumsum(need.astype(jnp.int32)) - 1
     n = need.sum().astype(jnp.int32)
     idx = state.free_top - 1 - rank
@@ -105,7 +133,7 @@ def _pop_pages(state: PagedKVState, need: jax.Array):
 def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
     """Give every active slot whose next write position opens a fresh
     page (len % page == 0) a page off the free stack."""
-    page = state.k_pools[0].shape[2]
+    _, page = _pool_geometry(state)
     slots, max_pages = state.page_table.shape
     need = state.active & (state.seq_lens % page == 0)
     pages, new_top, failed = _pop_pages(state, need)
@@ -120,29 +148,24 @@ def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
     )
 
 
-def _views(state: PagedKVState):
-    """Transient dense (slots, Hkv, max_pages*page, Dh) gather of each
-    slot's pages, per layer. The POOL is the persistent storage; these
-    views live only inside one decode tick."""
-    table = state.page_table  # (S, P)
-    s, p = table.shape
+def slot_cache(state: PagedKVState, slot: int, layer: int):
+    """DEBUG/TEST helper: gather ``slot``'s written cache for ``layer``
+    as dense (Hkv, Dh, seq_len) arrays (dequantized). Never called by
+    the serving path — the tick attends pages in place."""
+    num_pages, page = _pool_geometry(state)
 
-    def one(pool):
-        g = pool[table]                      # (S, P, Hkv, page, Dh)
-        g = g.transpose(0, 2, 1, 3, 4)       # (S, Hkv, P, page, Dh)
-        return g.reshape(s, g.shape[1], p * g.shape[3], g.shape[4])
+    def dense(pool):
+        if isinstance(pool, QuantizedPool):
+            vals = pool.values.astype(jnp.float32) * pool.scales[:, :, None, :]
+        else:
+            vals = pool.astype(jnp.float32)
+        g = vals[state.page_table[slot]]          # (P, Hkv, Dh, page)
+        g = g.transpose(1, 2, 0, 3).reshape(
+            vals.shape[1], vals.shape[2], -1
+        )
+        return g[:, :, : int(state.seq_lens[slot])]
 
-    return tuple(one(k) for k in state.k_pools), tuple(
-        one(v) for v in state.v_pools
-    )
-
-
-def _scatter_column(pool, pages, offsets, cols):
-    """pool[(pages[i], :, offsets[i], :)] = cols[i] with OOB pages
-    dropped (inactive slots)."""
-    return pool.at[pages, :, offsets, :].set(
-        cols.astype(pool.dtype), mode="drop"
-    )
+    return dense(state.k_pools[layer]), dense(state.v_pools[layer])
 
 
 def paged_decode_tick(
@@ -155,43 +178,51 @@ def paged_decode_tick(
     tick a single compiled program. Returns ((slots,) predictions,
     updated state)."""
     state = _alloc_for_tick(state)
-    page = state.k_pools[0].shape[2]
+    num_pages, page = _pool_geometry(state)
     slots = state.page_table.shape[0]
-    k_views, v_views = _views(state)
+
+    rows = jnp.arange(slots)
+    pidx = jnp.clip(state.seq_lens // page, 0, state.page_table.shape[1] - 1)
+    write_pages = jnp.where(
+        state.active, state.page_table[rows, pidx], num_pages  # OOB -> drop
+    )
+    info = PagedInfo(
+        state.page_table, state.seq_lens, write_pages,
+        state.seq_lens % page,
+    )
 
     preds, new_kvs = model.apply(
         params,
         feats_t[:, None, :],
-        cache=(k_views, v_views, state.seq_lens),
+        cache=(state.k_pools, state.v_pools, info),
     )
-
-    rows = jnp.arange(slots)
-    pidx = jnp.clip(state.seq_lens // page, 0, state.page_table.shape[1] - 1)
-    pages = jnp.where(
-        state.active,
-        state.page_table[rows, pidx],
-        state.k_pools[0].shape[0],  # OOB -> dropped
-    )
-    offsets = state.seq_lens % page
-    k_pools, v_pools = [], []
-    for layer, (k_view, v_view) in enumerate(new_kvs):
-        # the model wrote each slot's new kv column into its view at the
-        # slot's own position; persist that column into the pool
-        k_col = k_view[rows, :, state.seq_lens, :]  # (S, Hkv, Dh)
-        v_col = v_view[rows, :, state.seq_lens, :]
-        k_pools.append(
-            _scatter_column(state.k_pools[layer], pages, offsets, k_col)
-        )
-        v_pools.append(
-            _scatter_column(state.v_pools[layer], pages, offsets, v_col)
-        )
-
     state = state._replace(
-        k_pools=tuple(k_pools),
-        v_pools=tuple(v_pools),
+        k_pools=tuple(k for k, _ in new_kvs),
+        v_pools=tuple(v for _, v in new_kvs),
         seq_lens=state.seq_lens + state.active.astype(jnp.int32),
     )
     return preds[:, 0], state
+
+
+def _quantize_tokens(x: jax.Array):
+    """(..., Dh, T) -> int8 values + (..., T) per-(head, token) scales —
+    the shared symmetric scheme (one definition; the decode tick's
+    column writes must match the admit path's chunk writes exactly)."""
+    from beholder_tpu.ops.quant import quantize_symmetric
+
+    return quantize_symmetric(x, axis=-2)
+
+
+def _write_chunks(pool, drop_pages, chunks):
+    """Scatter (n, Hkv, Dh, page) chunks into pool rows ``drop_pages``
+    (OOB entries dropped), quantizing per token when the pool is int8."""
+    if isinstance(pool, QuantizedPool):
+        q, scale = _quantize_tokens(chunks)
+        return QuantizedPool(
+            pool.values.at[drop_pages].set(q, mode="drop"),
+            pool.scales.at[drop_pages].set(scale, mode="drop"),
+        )
+    return pool.at[drop_pages].set(chunks.astype(pool.dtype), mode="drop")
 
 
 def paged_admit(
@@ -209,52 +240,85 @@ def paged_admit(
     The page count is data-dependent but the WORK is not: the masked
     writes cover all T_max//page chunks and drop the dead ones.
     """
-    page = state.k_pools[0].shape[2]
-    num_pages = state.k_pools[0].shape[0]
+    preds, state = paged_admit_batch(
+        model, params, state,
+        jnp.asarray(slot, jnp.int32).reshape(1), feats_padded,
+        jnp.asarray(prefix_len, jnp.int32).reshape(1),
+    )
+    return preds[0], state
+
+
+def paged_admit_batch(
+    model: TelemetrySequenceModel,
+    params,
+    state: PagedKVState,
+    slot_ids: jax.Array,
+    feats_padded: jax.Array,
+    prefix_lens: jax.Array,
+):
+    """Admit a WAVE of requests in one prefill: ``feats_padded`` is
+    (n, T_max, F) (page-multiple T_max), ``slot_ids``/``prefix_lens``
+    are (n,). A request with ``prefix_lens[i] == 0`` is skipped (slot id
+    should then be out of range so its table write drops). Returns
+    ((n,) last predictions, state)."""
+    num_pages, page = _pool_geometry(state)
     slots, max_pages = state.page_table.shape
-    t_max = feats_padded.shape[1]
+    n, t_max, _ = feats_padded.shape
     if t_max % page:
         raise ValueError(f"padded prefix {t_max} not a page multiple ({page})")
     p_max = t_max // page
 
     preds, kvs = model.apply(params, feats_padded, return_kv=True)
-    last_pred = preds[0, jnp.clip(prefix_len - 1, 0, t_max - 1)]
+    last_pred = preds[
+        jnp.arange(n), jnp.clip(prefix_lens - 1, 0, t_max - 1)
+    ]
 
-    n_pages = -(-prefix_len // page)  # ceil
-    chunk_alive = jnp.arange(p_max) < n_pages
-    pages, new_top, failed = _pop_pages(state, chunk_alive)  # (p_max,)
-    failed = failed | (n_pages > max_pages)
-    table_row = jnp.where(
-        jnp.arange(max_pages) < n_pages,
-        jnp.pad(pages, (0, max(0, max_pages - p_max)))[:max_pages],
+    n_pages = -(-prefix_lens // page)                      # (n,) ceil
+    chunk_alive = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, p_max), 1)
+        < n_pages[:, None]
+    )
+    pages, new_top, failed = _pop_pages(state, chunk_alive.reshape(-1))
+    pages = pages.reshape(n, p_max)
+    failed = failed | jnp.any(n_pages > max_pages)
+
+    table_rows = jnp.where(
+        jax.lax.broadcasted_iota(jnp.int32, (n, max_pages), 1)
+        < n_pages[:, None],
+        jnp.pad(pages, ((0, 0), (0, max(0, max_pages - p_max))))[
+            :, :max_pages
+        ],
         0,
     )
+    drop = jnp.where(chunk_alive, pages, num_pages).reshape(-1)
 
     k_pools, v_pools = [], []
-    drop = jnp.where(chunk_alive, pages, num_pages)     # OOB -> dropped
     for layer, (k, v) in enumerate(kvs):
-        # (1, Hkv, T_max, Dh) -> (p_max, Hkv, page, Dh) page chunks
         def chunks(a):
-            a = a[0].transpose(1, 0, 2)                 # (T_max, Hkv, Dh)
-            a = a.reshape(p_max, page, a.shape[1], a.shape[2])
-            return a.transpose(0, 2, 1, 3)
-        k_pools.append(
-            state.k_pools[layer].at[drop].set(
-                chunks(k).astype(state.k_pools[layer].dtype), mode="drop"
+            # (n, Hkv, T_max, Dh) -> (n*p_max, Hkv, Dh, page)
+            hkv, dh = a.shape[1], a.shape[3]
+            a = a.transpose(0, 1, 3, 2)                 # (n, Hkv, Dh, T)
+            a = a.reshape(n, hkv, dh, p_max, page)
+            return a.transpose(0, 3, 1, 2, 4).reshape(
+                n * p_max, hkv, dh, page
             )
-        )
-        v_pools.append(
-            state.v_pools[layer].at[drop].set(
-                chunks(v).astype(state.v_pools[layer].dtype), mode="drop"
-            )
-        )
+        k_pools.append(_write_chunks(state.k_pools[layer], drop, chunks(k)))
+        v_pools.append(_write_chunks(state.v_pools[layer], drop, chunks(v)))
 
+    admitted = prefix_lens > 0
+    safe_slots = jnp.where(
+        admitted, jnp.clip(slot_ids, 0, slots - 1), slots  # OOB -> drop
+    )
     state = state._replace(
         k_pools=tuple(k_pools),
         v_pools=tuple(v_pools),
-        page_table=state.page_table.at[slot].set(table_row),
-        seq_lens=state.seq_lens.at[slot].set(prefix_len),
-        active=state.active.at[slot].set(True),
+        page_table=state.page_table.at[safe_slots].set(
+            table_rows, mode="drop"
+        ),
+        seq_lens=state.seq_lens.at[safe_slots].set(
+            prefix_lens, mode="drop"
+        ),
+        active=state.active.at[safe_slots].set(admitted, mode="drop"),
         free_top=new_top,
         alloc_failed=failed,
     )
@@ -263,8 +327,7 @@ def paged_admit(
 
 def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
     """Retire ``slot``: push its pages back onto the free stack."""
-    page = state.k_pools[0].shape[2]
-    num_pages = state.k_pools[0].shape[0]
+    num_pages, page = _pool_geometry(state)
     max_pages = state.page_table.shape[1]
     n = -(-state.seq_lens[slot] // page)
     alive = jnp.arange(max_pages) < n
@@ -282,6 +345,35 @@ def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
     )
 
 
+def paged_wave(
+    model: TelemetrySequenceModel,
+    params,
+    state: PagedKVState,
+    last_pred: jax.Array,
+    status_oh: jax.Array,
+    n_ticks: int,
+):
+    """Roll every active slot ``n_ticks`` decode steps ON DEVICE: the
+    prediction feedback loop runs inside one ``lax.scan`` (one compiled
+    program, zero per-token host traffic). Returns ((slots, n_ticks + 1)
+    deltas — the admit prediction plus each tick's, i.e. a horizon of
+    ``n_ticks + 1``) and the rolled state."""
+
+    def step(carry, _):
+        state, pred = carry
+        feats_t = jnp.concatenate([pred[:, None], status_oh], axis=-1)
+        new_pred, state = paged_decode_tick(
+            model, params, state, feats_t.astype(jnp.float32)
+        )
+        return (state, new_pred), pred
+
+    (state, last), deltas = jax.lax.scan(
+        step, (state, last_pred), None, length=n_ticks
+    )
+    deltas = jnp.concatenate([deltas.T, last[:, None]], axis=-1)
+    return deltas, state
+
+
 class Request(NamedTuple):
     progress: np.ndarray   # (T+1,) observed progress
     statuses: np.ndarray   # (T+1,) observed statuses
@@ -291,13 +383,12 @@ class Request(NamedTuple):
 class ContinuousBatcher:
     """Host-side vLLM-style scheduler over the paged state.
 
-    Submit any number of :class:`Request`\\ s, then :meth:`run`. The
-    batcher admits requests into free slots as they open (prefill is one
-    jit per admission; padded to ``max_prefix``), ticks every active
-    slot in one compiled step, feeds each slot's prediction back as its
-    next input, and retires slots whose horizon is exhausted — freeing
-    their pages for queued requests. Results are per-request forecast
-    delta arrays, equal to the dense per-request rollout.
+    Submit any number of :class:`Request`\\ s, then :meth:`run` (admit
+    into free slots as they open; one host round-trip per tick) or
+    :meth:`run_waves` (admit up to ``slots`` requests in ONE batched
+    prefill, roll the whole wave's horizon on device in one compiled
+    scan, retire, repeat — the throughput path). Results are per-request
+    forecast delta arrays, equal to the dense per-request rollout.
     """
 
     def __init__(
@@ -310,62 +401,132 @@ class ContinuousBatcher:
         slots: int = 4,
         max_prefix: int = 64,
         max_pages_per_seq: int = 32,
+        cache_dtype=jnp.bfloat16,
     ):
         self.model = model
         self.params = params
         self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages_per_seq = max_pages_per_seq
         self.max_prefix = -(-max_prefix // page_size) * page_size
         self.state = init_paged(
-            model, num_pages, page_size, slots, max_pages_per_seq
+            model, num_pages, page_size, slots, max_pages_per_seq,
+            cache_dtype=cache_dtype,
         )
         self.slots = slots
         self._tick = jax.jit(
             lambda p, s, f: paged_decode_tick(model, p, s, f)
         )
         self._admit = jax.jit(
-            lambda p, s, slot, feats, n: paged_admit(
-                model, p, s, slot, feats, n
+            lambda p, s, slot, feats, ns: paged_admit_batch(
+                model, p, s, slot, feats, ns
             )
         )
         self._release = jax.jit(paged_release)
+        # wave rollouts jit per horizon (the scan length is static)
+        self._wave_cache: dict[int, object] = {}
 
-    def run(self, requests: list[Request]) -> list[np.ndarray]:
+    # -- shared helpers -------------------------------------------------
+
+    def _need_pages(self, req: Request) -> int:
+        """Worst-case pages a request consumes: prefix + the horizon-1
+        fed-back tokens (the horizon-th prediction needs no tick — see
+        run()'s early release)."""
+        feats_len = len(req.progress) - 1
+        tokens = feats_len + max(req.horizon - 1, 0)
+        return -(-tokens // self.page_size)
+
+    def _prep(self, req: Request):
         from .sequence import stream_features
 
+        feats, _ = stream_features(
+            jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
+        )
+        t = feats.shape[1]
+        if t > self.max_prefix:
+            raise ValueError(
+                f"prefix {t} exceeds max_prefix {self.max_prefix}"
+            )
+        padded = jnp.pad(feats, ((0, 0), (0, self.max_prefix - t), (0, 0)))
+        return padded, t
+
+    def _check_servable(self, req: Request):
+        need = self._need_pages(req)
+        if need > self.num_pages or need > self.max_pages_per_seq:
+            raise RuntimeError(
+                f"page pool exhausted: request needs {need} pages "
+                f"(pool {self.num_pages}, per-seq cap "
+                f"{self.max_pages_per_seq}) — raise num_pages or shorten "
+                f"the horizon"
+            )
+
+    # -- flexible path: per-tick scheduling -----------------------------
+
+    def run(self, requests: list[Request]) -> list[np.ndarray]:
         queue = list(enumerate(requests))
         results: list = [None] * len(requests)
         # per-slot host bookkeeping
         req_of = [None] * self.slots
         deltas: list = [None] * self.slots
         remaining = np.zeros(self.slots, np.int64)
+        total_need = np.zeros(self.slots, np.int64)  # pages at horizon end
+        cur_len = np.zeros(self.slots, np.int64)     # tokens written
         last_pred = np.zeros(self.slots, np.float32)
         status_oh = np.zeros((self.slots, NUM_STATUSES), np.float32)
 
+        def committed() -> int:
+            """Pages active slots will STILL allocate: worst-case total
+            minus what they already hold (free_top already reflects held
+            pages, so subtracting total_need alone would double-count
+            growth that has materialized)."""
+            held = -(-cur_len // self.page_size)
+            return int(np.sum((total_need - held)[np.asarray(
+                [r is not None for r in req_of]
+            )]))
+
+        def retire(slot):
+            """Collect the slot's final delta WITHOUT running another
+            tick (the horizon-th prediction is last_pred itself; a tick
+            for it could allocate a page for a token nobody reads)."""
+            deltas[slot].append(last_pred[slot])
+            results[req_of[slot]] = np.asarray(deltas[slot], np.float32)
+            self.state = self._release(self.state, jnp.int32(slot))
+            req_of[slot] = None
+            total_need[slot] = 0
+            cur_len[slot] = 0
+
         while queue or any(r is not None for r in req_of):
-            # admit while there is a free slot and a queued request
+            # admit while there is a free slot, a queued request, AND
+            # enough free-page headroom after honoring every active
+            # slot's worst-case future growth (deferring beats the
+            # sticky alloc_failed abort)
             for slot in range(self.slots):
                 if not queue or req_of[slot] is not None:
                     continue
-                rid, req = queue.pop(0)
+                rid, req = queue[0]
                 if req.horizon <= 0:
                     # forecast_deltas(horizon=0) returns an empty array;
                     # skip the prefill/alloc round-trip entirely
+                    queue.pop(0)
                     results[rid] = np.zeros(0, np.float32)
                     continue
-                feats, _ = stream_features(
-                    jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
-                )
-                t = feats.shape[1]
-                if t > self.max_prefix:
-                    raise ValueError(
-                        f"prefix {t} exceeds max_prefix {self.max_prefix}"
-                    )
-                padded = jnp.pad(
-                    feats, ((0, 0), (0, self.max_prefix - t), (0, 0))
-                )
+                self._check_servable(req)
+                need = self._need_pages(req)
+                free = int(self.state.free_top) - committed()
+                if need > free:
+                    if not any(r is not None for r in req_of):
+                        raise RuntimeError(
+                            "page pool exhausted: request needs "
+                            f"{need} pages but only {free} exist free — "
+                            "raise num_pages or lower concurrency"
+                        )
+                    break  # defer until an active request retires
+                queue.pop(0)
+                padded, t = self._prep(req)
                 pred, self.state = self._admit(
-                    self.params, self.state, jnp.int32(slot), padded,
-                    jnp.int32(t),
+                    self.params, self.state,
+                    jnp.asarray([slot], jnp.int32), padded,
+                    jnp.asarray([t], jnp.int32),
                 )
                 if bool(self.state.alloc_failed):
                     raise RuntimeError(
@@ -375,10 +536,17 @@ class ContinuousBatcher:
                 req_of[slot] = rid
                 deltas[slot] = []
                 remaining[slot] = req.horizon
-                last_pred[slot] = float(pred)
+                total_need[slot] = need
+                cur_len[slot] = t
+                last_pred[slot] = float(pred[0])
                 status_oh[slot] = np.asarray(
                     jax.nn.one_hot(int(req.statuses[-1]), NUM_STATUSES)
                 )
+                if remaining[slot] == 1:
+                    retire(slot)  # the admit prediction was the forecast
+
+            if not any(r is not None for r in req_of):
+                continue
 
             # one compiled tick for every slot (inactive slots ride along)
             feats_t = jnp.asarray(
@@ -396,10 +564,99 @@ class ContinuousBatcher:
                 deltas[slot].append(last_pred[slot])
                 last_pred[slot] = preds[slot]
                 remaining[slot] -= 1
-                if remaining[slot] <= 0:
-                    results[req_of[slot]] = np.asarray(
-                        deltas[slot], np.float32
-                    )
-                    self.state = self._release(self.state, jnp.int32(slot))
-                    req_of[slot] = None
+                cur_len[slot] += 1  # the tick wrote this slot's token
+                if remaining[slot] <= 1:
+                    retire(slot)
+        return results
+
+    # -- throughput path: on-device waves -------------------------------
+
+    def _wave_fn(self, n_ticks: int):
+        fn = self._wave_cache.get(n_ticks)
+        if fn is None:
+            fn = jax.jit(
+                lambda p, s, pred, oh: paged_wave(
+                    self.model, p, s, pred, oh, n_ticks
+                )
+            )
+            self._wave_cache[n_ticks] = fn
+        return fn
+
+    def run_waves(self, requests: list[Request]) -> list[np.ndarray]:
+        """Fixed-horizon throughput mode: greedy waves of up to ``slots``
+        requests, each wave = one batched prefill + ONE compiled scan
+        over its max horizon (shorter-horizon members ride along; their
+        surplus deltas are dropped host-side). Page headroom is checked
+        per wave, with ride-along growth counted at the wave horizon."""
+        results: list = [None] * len(requests)
+        queue = list(enumerate(requests))
+        while queue:
+            wave: list = []
+            free = int(self.state.free_top)
+            horizon = 0
+            while queue and len(wave) < self.slots:
+                rid, req = queue[0]
+                if req.horizon <= 0:
+                    queue.pop(0)
+                    results[rid] = np.zeros(0, np.float32)
+                    continue
+                self._check_servable(req)
+                t = len(req.progress) - 1
+                h = max(horizon, req.horizon)
+                # wave members decode h-1 ticks regardless of their own
+                # horizon, so BOTH headroom checks run at the wave's
+                # grown horizon: total pool pages AND each member's
+                # page-table cap (a short request riding a long one can
+                # overflow its own table — deferred to the next wave)
+                def pages_at(r, hh):
+                    return -(-(len(r.progress) - 1 + hh - 1)
+                             // self.page_size)
+
+                need = pages_at(req, h)
+                others = sum(pages_at(r, h) for _, r in wave)
+                over_cap = any(
+                    pages_at(r, h) > self.max_pages_per_seq
+                    for r in [req] + [r for _, r in wave]
+                )
+                if need + others > free or over_cap:
+                    if not wave:
+                        raise RuntimeError(
+                            f"page pool exhausted: request needs {need} "
+                            f"pages but only {free} exist free (per-seq "
+                            f"cap {self.max_pages_per_seq})"
+                        )
+                    break
+                queue.pop(0)
+                wave.append((rid, req))
+                horizon = h
+            if not wave:
+                continue
+
+            prepped = [self._prep(req) for _, req in wave]
+            feats = jnp.concatenate([p for p, _ in prepped], axis=0)
+            lens = jnp.asarray([t for _, t in prepped], jnp.int32)
+            slot_ids = jnp.arange(len(wave), dtype=jnp.int32)
+            preds, self.state = self._admit(
+                self.params, self.state, slot_ids, feats, lens
+            )
+            if bool(self.state.alloc_failed):
+                raise RuntimeError("page pool exhausted during admit")
+            oh = np.zeros((self.slots, NUM_STATUSES), np.float32)
+            pred0 = np.zeros(self.slots, np.float32)
+            for i, (_, req) in enumerate(wave):
+                oh[i] = np.asarray(
+                    jax.nn.one_hot(int(req.statuses[-1]), NUM_STATUSES)
+                )
+                pred0[i] = float(preds[i])
+
+            deltas, self.state = self._wave_fn(horizon - 1)(
+                self.params, self.state, jnp.asarray(pred0),
+                jnp.asarray(oh),
+            )
+            if bool(self.state.alloc_failed):
+                raise RuntimeError("page pool exhausted mid-decode")
+            deltas = np.asarray(deltas, np.float32)
+            for i, (rid, req) in enumerate(wave):
+                results[rid] = deltas[i, : req.horizon]
+                self.state = self._release(self.state, jnp.int32(i))
         return results

@@ -354,6 +354,7 @@ class FrameParser:
     def __init__(self, use_native: bool | None = None):
         self._buf = bytearray()
         self._scanner = None
+        self._ext = None
         if use_native is None:
             import os
 
@@ -364,14 +365,33 @@ class FrameParser:
             if _native.available() and os.environ.get(
                 "BEHOLDER_NATIVE_CODEC"
             ) != "0":
-                self._scanner = _native.NativeScanner()
+                self._bind_native(_native)
         elif use_native:
             from . import _native
 
-            self._scanner = _native.NativeScanner()  # raises if unbuilt
+            if not _native.available():
+                raise RuntimeError(
+                    "native frame codec not built (run `make native`)"
+                )
+            self._bind_native(_native)
+
+    def _bind_native(self, _native):
+        """Prefer the C-API extension (~0.3us fixed/feed); the ctypes
+        scanner is the fallback when only libframecodec.so was built."""
+        if _native.ext_available():
+            self._ext = _native.ext_scan  # bound once; feed stays lean
+        else:
+            self._scanner = _native.NativeScanner()
 
     def feed(self, data: bytes) -> list[Frame]:
         self._buf.extend(data)
+        if self._ext:
+            try:
+                frames, consumed = self._ext(self._buf, Frame)
+            except ValueError as err:
+                raise ProtocolError(str(err)) from None
+            del self._buf[:consumed]
+            return frames
         if self._scanner is not None:
             try:
                 frames, consumed = self._scanner.scan(self._buf, Frame)

@@ -12,6 +12,7 @@ reference; parity components live in the sibling packages.
 
 from .aggregate import NUM_STATUSES, aggregate_telemetry, ewma, status_counts
 from .moe import SwitchFFN, expert_shardings, expert_specs
+from .paged_attention import PagedInfo, QuantizedPool, paged_decode_attention
 from .pallas_aggregate import aggregate_telemetry_pallas
 from .quant import (
     dequantize_params,
@@ -30,6 +31,9 @@ __all__ = [
     "SwitchFFN",
     "expert_shardings",
     "expert_specs",
+    "PagedInfo",
+    "QuantizedPool",
+    "paged_decode_attention",
     "dequantize_params",
     "dequantize_weight",
     "quantize_params",

@@ -155,6 +155,7 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     window: int | None = None,
+    backend: str = "flash",
 ) -> jax.Array:
     """Context-parallel attention over the ``axis`` dimension of ``mesh``.
 
@@ -178,6 +179,15 @@ def ring_attention(
     AND ring communication scale with the window instead of the ring
     size (the backward completes the gradient circle with one multi-hop
     permutation).
+
+    ``backend="flash"`` (default) runs each rotation's local block
+    attend INSIDE the Pallas flash kernels — the masks take the rotated
+    block's global row offsets, so the distributed long-context path
+    runs at kernel rate, not XLA-einsum rate (the round-3 gap). The
+    forward combines each pair's (o, logsumexp) with the online-softmax
+    recurrence; the backward recomputes each pair's probabilities from
+    the saved GLOBAL logsumexp inside the flash backward kernels.
+    ``backend="einsum"`` keeps the transparent XLA reference path.
     """
     p_size = mesh.shape[axis]
     t = q.shape[-2]
@@ -205,7 +215,7 @@ def ring_attention(
                 f"{mesh.shape['tp']} — pick kv_heads as a multiple of tp "
                 f"(or repeat kv heads before the call)"
             )
-    return _ring_vjp(mesh, axis, causal, q.ndim, window)(q, k, v)
+    return _ring_vjp(mesh, axis, causal, q.ndim, window, backend)(q, k, v)
 
 
 def _ring_steps(p_size: int, block: int, causal: bool, window) -> int:
@@ -221,7 +231,8 @@ def _ring_steps(p_size: int, block: int, causal: bool, window) -> int:
 
 
 def _ring_local_fwd(
-    qb, kb, vb, *, axis, p_size, block, causal, want_lse, window=None
+    qb, kb, vb, *, axis, p_size, block, causal, want_lse, window=None,
+    backend="flash",
 ):
     """Per-device forward: online-softmax over the live ring rotations.
 
@@ -231,21 +242,58 @@ def _ring_local_fwd(
     neutralized by the combine: step 0 is the device's own (live)
     diagonal block, so the running max is finite and a -inf block max
     scales its contribution to exactly zero.
+
+    ``backend="flash"`` computes each pair on the Pallas kernel
+    (:func:`~beholder_tpu.ops.flash_attention.flash_block_attend`): the
+    diagonal step runs the packed causal grid, rotated steps take the
+    traced global offsets; each pair's normalized (o, lse) enters the
+    same combine as a (m=lse, l=1, o) pseudo-block.
     """
     idx = jax.lax.axis_index(axis)
     q_offset = idx * block
 
-    qg, _ = _grouped(qb, kb)
-    m = jnp.full(qg.shape[:-1], _NEG_INF, jnp.float32)
-    l = jnp.zeros(qg.shape[:-1], jnp.float32)
-    o = jnp.zeros(qg.shape, jnp.float32)
     kc, vc, kv_idx = kb, vb, idx
-
     # static unroll over the (known) live step count: the last block
     # needs no further hop, and XLA overlaps each ppermute with the next
     # step's compute
     n_steps = _ring_steps(p_size, block, causal, window)
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    if backend == "flash":
+        from beholder_tpu.ops.flash_attention import flash_block_attend
+
+        m = l = o = None
+        for step in range(n_steps):
+            if causal and step == 0:
+                ob, lb = flash_block_attend(
+                    qb, kc, vc, causal=True, window=window
+                )
+            else:
+                # rotated pair: global offsets drive the masks (None for
+                # the non-causal ring, which has no mask to place)
+                offs = (
+                    dict(q_offset=q_offset, kv_offset=kv_idx * block)
+                    if causal
+                    else {}
+                )
+                ob, lb = flash_block_attend(
+                    qb, kc, vc, causal=causal, window=window, **offs
+                )
+            blk = (lb, jnp.ones_like(lb), ob.astype(jnp.float32))
+            m, l, o = blk if step == 0 else _combine((m, l, o), blk)
+            if step < n_steps - 1:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+                kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+        out = (o / l[..., None]).astype(qb.dtype)
+        if not want_lse:
+            return out
+        return out, m + jnp.log(jnp.maximum(l, 1e-37))
+
+    qg, _ = _grouped(qb, kb)
+    m = jnp.full(qg.shape[:-1], _NEG_INF, jnp.float32)
+    l = jnp.zeros(qg.shape[:-1], jnp.float32)
+    o = jnp.zeros(qg.shape, jnp.float32)
     for step in range(n_steps):
         blk = _block_attend(
             qb, kc, vc, q_offset, kv_idx * block, causal, window
@@ -269,7 +317,8 @@ def _ring_local_fwd(
 
 
 def _ring_local_bwd(
-    qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal, window=None
+    qb, kb, vb, ob, lse, dob, *, axis, p_size, block, causal, window=None,
+    backend="flash",
 ):
     """Per-device flash-style backward over a second ring pass.
 
@@ -280,7 +329,58 @@ def _ring_local_bwd(
     dk/dv accumulate at kv-head width (the group dim contracts in the
     einsums); fully masked rows recompute p as exp(-inf - lse) = 0, so
     dead (wrapped/out-of-band) blocks contribute exact zeros.
+
+    ``backend="flash"`` computes each pair's (dq, dk, dv) inside the
+    flash backward kernels from the saved GLOBAL logsumexp
+    (:func:`~beholder_tpu.ops.flash_attention.flash_block_backward`),
+    with the same offset-driven masks as the forward.
     """
+    if backend == "flash":
+        from beholder_tpu.ops.flash_attention import flash_block_backward
+
+        idx = jax.lax.axis_index(axis)
+        q_offset = idx * block
+        kc, vc, kv_idx = kb, vb, idx
+        dq = jnp.zeros(qb.shape, jnp.float32)
+        dkc = jnp.zeros(kb.shape, jnp.float32)
+        dvc = jnp.zeros(vb.shape, jnp.float32)
+        n_steps = _ring_steps(p_size, block, causal, window)
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        for step in range(n_steps):
+            if causal and step == 0:
+                dq_s, dk_s, dv_s = flash_block_backward(
+                    qb, kc, vc, ob, lse, dob, causal=True, window=window
+                )
+            else:
+                offs = (
+                    dict(q_offset=q_offset, kv_offset=kv_idx * block)
+                    if causal
+                    else {}
+                )
+                dq_s, dk_s, dv_s = flash_block_backward(
+                    qb, kc, vc, ob, lse, dob, causal=causal,
+                    window=window, **offs
+                )
+            dq = dq + dq_s.astype(jnp.float32)
+            dkc = dkc + dk_s.astype(jnp.float32)
+            dvc = dvc + dv_s.astype(jnp.float32)
+            if step < n_steps - 1:
+                kc = jax.lax.ppermute(kc, axis, perm)
+                vc = jax.lax.ppermute(vc, axis, perm)
+                kv_idx = jax.lax.ppermute(kv_idx, axis, perm)
+                dkc = jax.lax.ppermute(dkc, axis, perm)
+                dvc = jax.lax.ppermute(dvc, axis, perm)
+        shift = p_size - (n_steps - 1)
+        if shift % p_size:
+            jump = [(j, (j + shift) % p_size) for j in range(p_size)]
+            dkc = jax.lax.ppermute(dkc, axis, jump)
+            dvc = jax.lax.ppermute(dvc, axis, jump)
+        return (
+            dq.astype(qb.dtype),
+            dkc.astype(kb.dtype),
+            dvc.astype(vb.dtype),
+        )
+
     d = qb.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     idx = jax.lax.axis_index(axis)
@@ -362,9 +462,12 @@ def _lead_axes(mesh: Mesh, ndim: int) -> list:
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int, window=None):
+def _ring_vjp(
+    mesh: Mesh, axis: str, causal: bool, ndim: int, window=None,
+    backend="flash",
+):
     """custom-VJP ring attention bound to (mesh, axis, causal, rank,
-    window)."""
+    window, backend)."""
     p_size = mesh.shape[axis]
     lead = _lead_axes(mesh, ndim)
     spec = P(*lead, axis, None)
@@ -383,6 +486,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int, window=None):
             functools.partial(
                 _ring_local_fwd, axis=axis, p_size=p_size, block=block,
                 causal=causal, want_lse=False, window=window,
+                backend=backend,
             ),
             (spec, spec, spec), spec,
         )(q, k, v)
@@ -393,6 +497,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int, window=None):
             functools.partial(
                 _ring_local_fwd, axis=axis, p_size=p_size, block=block,
                 causal=causal, want_lse=True, window=window,
+                backend=backend,
             ),
             (spec, spec, spec), (spec, lse_spec),
         )(q, k, v)
@@ -404,7 +509,7 @@ def _ring_vjp(mesh: Mesh, axis: str, causal: bool, ndim: int, window=None):
         return shard(
             functools.partial(
                 _ring_local_bwd, axis=axis, p_size=p_size, block=block,
-                causal=causal, window=window,
+                causal=causal, window=window, backend=backend,
             ),
             (spec, spec, spec, spec, lse_spec, spec),
             (spec, spec, spec),

@@ -101,15 +101,18 @@ def _interpret() -> bool:
 
 
 def _masked_dispatch(step, *, causal, qi, kj, n_blk, padded, window=None,
-                     block=None, has_seg=False):
+                     block=None, has_seg=False, has_off=False):
     """Run ``step(masked)`` with masking only where it can bite: the causal
     diagonal block, (when T was padded) the last kv block, and (under a
     sliding window) the band's trailing-edge blocks. Interior blocks skip
     the iota/compare/select entirely. Segment ids are runtime data, so
-    with ``has_seg`` every block masks. Padded q ROWS never need a mask in
-    the backward kernels: their lse is +BIG so the recomputed
-    probabilities underflow to exactly 0."""
-    if has_seg:
+    with ``has_seg`` every block masks — and likewise global row/col
+    OFFSETS (``has_off``, the ring-attention block-pair path): the mask
+    position depends on traced scalars, so no block's liveness is known
+    at trace time. Padded q ROWS never need a mask in the backward
+    kernels: their lse is +BIG so the recomputed probabilities underflow
+    to exactly 0."""
+    if has_seg or has_off:
         step(True)
         return
     needs_mask = (qi == kj) if causal else False
@@ -203,7 +206,7 @@ _SUB = 1024
 def _fwd_kernel(
     q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
     m_ref, l_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block,
-    window,
+    window, qoff=None, kvoff=None,
 ):
     """One (block, d) q tile x one streamed (block, d) kv tile.
 
@@ -224,7 +227,7 @@ def _fwd_kernel(
     """
     n_blk = t_pad // block
     has_seg = qseg_ref is not None
-    if causal:
+    if band is not None:  # packed causal grid (no band in offset mode)
         qi, kj, is_first, is_last = band  # scalar-prefetch table reads
     else:
         qi = pl.program_id(1)
@@ -259,7 +262,12 @@ def _fwd_kernel(
                 cols = kj * block + j2 * sub + jax.lax.broadcasted_iota(
                     jnp.int32, (block, sub), 1
                 )
-                valid = cols < t_real
+                valid = cols < t_real  # padding is LOCAL to this shard
+                if qoff is not None:
+                    # ring block pair: causal/window run on GLOBAL
+                    # positions (traced per-device offsets)
+                    rows = rows + qoff
+                    cols = cols + kvoff
                 if causal:
                     valid = valid & (rows >= cols)
                 if window is not None:
@@ -281,9 +289,10 @@ def _fwd_kernel(
             m_prev = m_ref[:, :1]          # (bq, 1); lanes hold copies
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)         # (bq, sub) f32
-            if has_seg:
-                # a fully-masked block leaves m_new at -inf and p at
-                # exp(0)=1; zero the masked entries explicitly
+            if has_seg or qoff is not None:
+                # a fully-masked block (runtime segments, or a ring pair
+                # wholly dead/out-of-band at these offsets) leaves m_new
+                # at -inf and p at exp(0)=1; zero explicitly
                 p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
             alpha = jnp.exp(m_prev - m_new)
             l_ref[:] = jnp.broadcast_to(
@@ -302,6 +311,7 @@ def _fwd_kernel(
     _masked_dispatch(
         _chunks, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
         padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
+        has_off=qoff is not None,
     )
 
     @pl.when(is_last)
@@ -354,19 +364,27 @@ def _seg_specs(has_seg, block, qseg_map, kseg_map):
     static_argnames=("causal", "interpret", "t_real", "scale", "window"),
 )
 def _flash_fwd_padded(
-    q, k, v, qseg=None, kseg=None, *, causal, interpret, t_real, scale,
-    window=None,
+    q, k, v, qseg=None, kseg=None, offsets=None, *, causal, interpret,
+    t_real, scale, window=None,
 ):
     """(BH, T_pad, d_pad) q + (BHkv, T_pad, d_pad) k/v -> (o, lse) with
     q's padding. GQA: q head ``b`` attends kv head ``b // group``.
     ``qseg``/``kseg`` are the pre-broadcast segment operands from
     :func:`_seg_operands`; ``window`` is the causal sliding-window span.
+    ``offsets`` (a traced (2,) int32 [q_offset, kv_offset]) switches to
+    the ring BLOCK-PAIR mode: causal/window masks run on global
+    positions, every block masks (liveness is runtime data), and the
+    grid is the plain rectangular one (a packed triangular grid assumes
+    the diagonal sits at equal offsets).
     """
     bh, t_pad, d_pad = q.shape
     group = bh // k.shape[0]
     block = _pick_block(t_pad, window)
     n_blk = t_pad // block
     has_seg = qseg is not None
+    has_off = offsets is not None
+    if has_seg and has_off:
+        raise NotImplementedError("segment ids + ring offsets unsupported")
     seg_in = [qseg, kseg] if has_seg else []
     # segment operands are BATCH-lead (see _seg_operands): divide the
     # flat (B*H) grid index down to the batch
@@ -382,6 +400,46 @@ def _flash_fwd_padded(
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((bh, t_pad, _LANES), jnp.float32),
     ]
+
+    if has_off:
+        # ring block-pair mode: rectangular grid, offsets scalar-
+        # prefetched into SMEM, every block masked on global positions
+        def kernel(offs_ref, q_ref, k_ref, v_ref, *rest):
+            o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
+            _fwd_kernel(
+                q_ref, k_ref, v_ref, None, None, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, None,
+                t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
+                block=block, window=window, qoff=offs_ref[0],
+                kvoff=offs_ref[1],
+            )
+
+        o, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(bh, n_blk, n_blk),
+                in_specs=[
+                    pl.BlockSpec((1, block, d_pad), lambda b, i, j, o_: (b, i, 0)),
+                    pl.BlockSpec(
+                        (1, block, d_pad),
+                        lambda b, i, j, o_: (b // group, j, 0),
+                    ),
+                    pl.BlockSpec(
+                        (1, block, d_pad),
+                        lambda b, i, j, o_: (b // group, j, 0),
+                    ),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block, d_pad), lambda b, i, j, o_: (b, i, 0)),
+                    pl.BlockSpec((1, block, _LANES), lambda b, i, j, o_: (b, i, 0)),
+                ],
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(offsets, q, k, v)
+        return o, lse[:, :, 0]
 
     if causal:
         # packed banded grid: one step per LIVE (qi, kj) block pair,
@@ -478,10 +536,11 @@ def _flash_fwd_padded(
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
     dq_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block, window,
+    qoff=None, kvoff=None,
 ):
     n_blk = t_pad // block
     has_seg = qseg_ref is not None
-    if causal:
+    if band is not None:  # packed causal grid (no band in offset mode)
         qi, kj, is_first, is_last = band  # packed banded grid (see forward)
     else:
         qi = pl.program_id(1)
@@ -507,7 +566,10 @@ def _dq_kernel(
             cols = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1
             )
-            valid = cols < t_real
+            valid = cols < t_real  # padding is LOCAL to this shard
+            if qoff is not None:
+                rows = rows + qoff
+                cols = cols + kvoff
             if causal:
                 valid = valid & (rows >= cols)
             if window is not None:
@@ -535,6 +597,7 @@ def _dq_kernel(
     _masked_dispatch(
         _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
         padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
+        has_off=qoff is not None,
     )
 
     @pl.when(is_last)
@@ -550,11 +613,11 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
     dk_ref, dv_ref, dk_acc, dv_acc, band, *, t_real, t_pad, causal, scale,
-    block, window,
+    block, window, qoff=None, kvoff=None,
 ):
     n_blk = t_pad // block
     has_seg = qseg_ref is not None
-    if causal:
+    if band is not None:  # packed causal grid (no band in offset mode)
         kj, qi, is_first, is_last = band  # packed banded grid, q innermost
     else:
         kj = pl.program_id(1)
@@ -582,7 +645,10 @@ def _dkv_kernel(
             cols = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1
             )
-            valid = cols < t_real
+            valid = cols < t_real  # padding is LOCAL to this shard
+            if qoff is not None:
+                rows = rows + qoff
+                cols = cols + kvoff
             if causal:
                 valid = valid & (rows >= cols)
             if window is not None:
@@ -610,6 +676,7 @@ def _dkv_kernel(
     _masked_dispatch(
         _step, causal=causal, qi=qi, kj=kj, n_blk=n_blk,
         padded=t_pad != t_real, window=window, block=block, has_seg=has_seg,
+        has_off=qoff is not None,
     )
 
     @pl.when(is_last)
@@ -623,8 +690,8 @@ def _dkv_kernel(
     static_argnames=("causal", "interpret", "t_real", "scale", "window"),
 )
 def _flash_bwd_padded(
-    q, k, v, o, lse, do, qseg=None, kseg=None, *, causal, interpret, t_real,
-    scale, window=None,
+    q, k, v, o, lse, do, qseg=None, kseg=None, offsets=None, *, causal,
+    interpret, t_real, scale, window=None,
 ):
     """Padded (BH, T_pad, d_pad) residuals + cotangent -> (dq, dk, dv).
 
@@ -673,6 +740,66 @@ def _flash_bwd_padded(
         segs = (rest[0], rest[1]) if has_seg else (None, None)
         tail = rest[2:] if has_seg else rest
         return (*ins, *segs, *tail)
+
+    if offsets is not None:
+        # ring block-pair mode (see _flash_fwd_padded): rectangular
+        # grids, offsets scalar-prefetched, every block masked globally
+        if has_seg:
+            raise NotImplementedError(
+                "segment ids + ring offsets unsupported"
+            )
+
+        def dq_kernel(offs_ref, *refs):
+            _dq_kernel(
+                *unpack(refs), None, t_real=t_real, t_pad=t_pad,
+                causal=causal, scale=scale, block=block, window=window,
+                qoff=offs_ref[0], kvoff=offs_ref[1],
+            )
+
+        q_res = lambda b, i, j, o_: (b, i, 0)
+        kv_stream = lambda b, i, j, o_: (b // group, j, 0)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(bh, n_blk, n_blk),
+                in_specs=[
+                    tile(q_res), tile(kv_stream), tile(kv_stream),
+                    tile(q_res), rows(q_res), rows(q_res),
+                ],
+                out_specs=tile(q_res),
+                scratch_shapes=dq_scratch,
+            ),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(offsets, q, k, v, do, lse_b, delta_b)
+
+        def dkv_kernel(offs_ref, *refs):
+            _dkv_kernel(
+                *unpack(refs), None, t_real=t_real, t_pad=t_pad,
+                causal=causal, scale=scale, block=block, window=window,
+                qoff=offs_ref[0], kvoff=offs_ref[1],
+            )
+
+        kv_res = lambda b, j, i, o_: (b // group, j, 0)
+        dkv_res = lambda b, j, i, o_: (b, j, 0)
+        q_stream = lambda b, j, i, o_: (b, i, 0)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(bh, n_blk, n_blk),
+                in_specs=[
+                    tile(q_stream), tile(kv_res), tile(kv_res),
+                    tile(q_stream), rows(q_stream), rows(q_stream),
+                ],
+                out_specs=[tile(dkv_res), tile(dkv_res)],
+                scratch_shapes=dkv_scratch,
+            ),
+            out_shape=dkv_out_shape,
+            interpret=interpret,
+        )(offsets, q, k, v, do, lse_b, delta_b)
+        return dq, dk, dv
 
     if causal:
         # packed banded grids (same trick as the forward): one grid step
@@ -878,6 +1005,110 @@ def _int_zero_tangent(x):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_block_attend(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: int | None = None,
+    q_offset=None,
+    kv_offset=None,
+):
+    """One block pair's attention + logsumexp — the ring-attention local
+    step, on the kernel.
+
+    q is (..., Tq, d), k/v (..., Tk, d) (GQA: fewer kv heads on -3).
+    With ``q_offset``/``kv_offset`` (traced per-device scalars) the
+    causal/window masks run on GLOBAL row/col positions — a rotated kv
+    block knows where it came from; fully dead pairs yield o=0,
+    lse=-inf, which the online-softmax combine neutralizes. Returns
+    (o (..., Tq, d) in q's dtype, lse (..., Tq) f32, both UNnormalized
+    across pairs — combine with the flash recurrence)."""
+    shape = q.shape
+    t, d = shape[-2], shape[-1]
+    q3 = q.reshape(-1, t, d)
+    k3, v3 = (a.reshape(-1, a.shape[-2], d) for a in (k, v))
+    t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
+    d_pad = -(-d // _LANES) * _LANES
+    scale = float(1.0 / (d**0.5))
+    qp, kp, vp = (_pad_to(a, t_pad, d_pad) for a in (q3, k3, v3))
+    offs = None
+    eff_causal = causal
+    if q_offset is not None:
+        offs = jnp.stack(
+            [
+                jnp.asarray(q_offset, jnp.int32),
+                jnp.asarray(kv_offset, jnp.int32),
+            ]
+        )
+    o, lse = _flash_fwd_padded(
+        qp, kp, vp, None, None, offs, causal=eff_causal,
+        interpret=_interpret(), t_real=t, scale=scale, window=window,
+    )
+    return (
+        o[:, :t, :d].reshape(shape),
+        lse[:, :t].reshape(shape[:-1]),
+    )
+
+
+def flash_block_backward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    o: jax.Array,
+    lse: jax.Array,
+    do: jax.Array,
+    *,
+    causal: bool = True,
+    window: int | None = None,
+    q_offset=None,
+    kv_offset=None,
+):
+    """Block-pair gradients for the ring backward: recompute this pair's
+    probabilities from the GLOBAL logsumexp (``lse``, as saved by the
+    ring forward) and return (dq, dk, dv) — dk/dv group-reduced to kv
+    heads under GQA. ``o``/``do`` are the device's (global) output and
+    cotangent; offsets as in :func:`flash_block_attend`."""
+    shape = q.shape
+    t, d = shape[-2], shape[-1]
+    q3 = q.reshape(-1, t, d)
+    k3, v3 = (a.reshape(-1, a.shape[-2], d) for a in (k, v))
+    o3, do3 = (a.reshape(-1, t, d) for a in (o, do))
+    lse3 = lse.reshape(-1, t)
+    bh = q3.shape[0]
+    group = bh // k3.shape[0]
+    t_pad = -(-t // _MIN_BLOCK) * _MIN_BLOCK
+    d_pad = -(-d // _LANES) * _LANES
+    scale = float(1.0 / (d**0.5))
+    qp, kp, vp, op, dop = (
+        _pad_to(a, t_pad, d_pad) for a in (q3, k3, v3, o3, do3)
+    )
+    lse_p = jnp.pad(lse3, ((0, 0), (0, t_pad - t)), constant_values=1e30)
+    offs = None
+    if q_offset is not None:
+        offs = jnp.stack(
+            [
+                jnp.asarray(q_offset, jnp.int32),
+                jnp.asarray(kv_offset, jnp.int32),
+            ]
+        )
+    dq, dk, dv = _flash_bwd_padded(
+        qp, kp, vp, op, lse_p, dop, None, None, offs, causal=causal,
+        interpret=_interpret(), t_real=t, scale=scale, window=window,
+    )
+    if group > 1:
+        dk = dk.reshape(k3.shape[0], group, t_pad, d_pad)
+        dk = dk.astype(jnp.float32).sum(axis=1).astype(k.dtype)
+        dv = dv.reshape(v3.shape[0], group, t_pad, d_pad)
+        dv = dv.astype(jnp.float32).sum(axis=1).astype(v.dtype)
+    return (
+        dq[:, :t, :d].reshape(shape),
+        dk[:, :t, :d].reshape(k.shape),
+        dv[:, :t, :d].reshape(v.shape),
+    )
 
 
 def flash_attention(

@@ -1,0 +1,405 @@
+"""Paged-KV decode attention as a Pallas TPU kernel.
+
+EXTENSION BEYOND THE REFERENCE (which has no inference of any kind —
+SURVEY.md §0). This is the compute half of vLLM-style paged serving
+(:mod:`beholder_tpu.models.serving` owns the pool/page-table data
+structures): each slot's single query attends its OWN pages read IN
+PLACE from the HBM pool via the page table — the round-3 implementation
+instead gathered every slot's pages into a dense transient
+``(slots, Hkv, max_pages*page, Dh)`` view per layer per tick, so HBM
+traffic scaled with the maximum page span and "paged" was only true of
+the persistent storage, not the compute.
+
+Kernel design:
+
+- The pools stay in HBM (``memory_space=ANY``); the kernel walks each
+  slot's LIVE pages (``lens[s] // page + 1`` of them, minus any fully
+  out-of-window leading pages) with double-buffered ``make_async_copy``
+  DMAs — pages the slot does not own are never touched, so per-tick HBM
+  traffic scales with tokens actually in flight.
+- One kernel invocation serves ALL slots (a static unrolled loop, one
+  dynamic ``fori_loop`` over pages per slot) — there is no per-slot grid
+  step, so the whole tick pays ONE kernel dispatch per layer. Decode at
+  telemetry-model sizes is latency-bound; grid-step fixed costs would
+  dominate a (slots, pages) grid.
+- The page table and lengths ride SMEM (they index the DMAs; the scalar
+  core reads them directly).
+- The online-softmax state (m, l, acc) is a tiny per-slot register
+  carry; the (H, page) score block exists only in VMEM. Positions past
+  ``lens[s]`` (and, under a sliding window, at or before
+  ``lens[s] - window``) are masked with -inf, matching the dense cache
+  path's mask in :class:`beholder_tpu.models.sequence.Block`.
+- Grouped-query attention is native: q carries H = G * Hkv heads, the
+  pools carry Hkv; q head h reads pool head h // G (a static slice — the
+  group loop is unrolled).
+- Int8 pools (``k_scale``/``v_scale`` given): pages are stored int8 with
+  per-(token, head) float32 scales and dequantized IN the kernel right
+  after the DMA — int8 is the HBM-resident representation, so the
+  serving-memory wall AND decode bandwidth halve vs bf16 (the same
+  argument :mod:`beholder_tpu.ops.quant` makes for weights).
+- Pool layout is (N, Hkv, Dh, page) — TOKENS ON LANES. Mosaic requires
+  HBM DMA slices to be lane-aligned (128) on the minor dim; head dims
+  are 64-ish but a page of tokens is naturally 128+, and this layout is
+  also exactly what both kernel matmuls want: scores contract q's Dh
+  against the page's leading Dh (no transpose), PV contracts the page
+  axis directly. On real TPUs ``page`` must be a multiple of 128 (the
+  interpreter used by CPU tests has no such constraint, so tests keep
+  tiny pages).
+- On non-TPU backends the kernel runs in interpreter mode — the CPU-mesh
+  tests exercise the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+class QuantizedPool(NamedTuple):
+    """Int8 KV page pool: ``values`` (N, Hkv, Dh, page) int8 plus
+    per-(head, token) symmetric ``scales`` (N, Hkv, page) f32 —
+    ``k ≈ values * scales`` with tokens on lanes. The decode kernel
+    dequantizes right after each page DMA, so int8 is the HBM-resident
+    representation (half the cache bytes AND half the page traffic)."""
+
+    values: jax.Array
+    scales: jax.Array
+
+
+class PagedInfo(NamedTuple):
+    """Per-tick paged-cache bookkeeping handed to the model's blocks.
+
+    ``lens[s]`` is the number of tokens already in slot ``s``'s pages;
+    the tick's new kv column is written at position ``lens[s]`` (page
+    ``write_pages[s]``, row ``write_offsets[s]`` — pre-resolved by the
+    scheduler, with an out-of-bounds page id for inactive slots so the
+    write drops).
+    """
+
+    page_table: jax.Array     # (S, P) int32 pool page ids
+    lens: jax.Array           # (S,) int32
+    write_pages: jax.Array    # (S,) int32 (OOB -> dropped write)
+    write_offsets: jax.Array  # (S,) int32 row inside the write page
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _paged_kernel(
+    table_ref, lens_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
+    kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sems, *, page,
+    window, slots, group, scale,
+):
+    """See module docstring. ``ks_ref``/``vs_ref``/``ksbuf``/``vsbuf``
+    are None for bf16 pools. ``sems`` is a (4, 2, slots) DMA semaphore
+    array: [k, v, kscale, vscale] x [buffer] x [slot].
+
+    Slots advance in LOCKSTEP page rounds: round ``i`` issues every
+    live slot's page-``i`` DMA together (they overlap in the memory
+    system, so HBM latency amortizes across slots — a slot-serial walk
+    pays it ``slots`` times over), double-buffered against round
+    ``i+1``. Rounds where a slot is dead (page out of its live
+    [p_lo, n_pages) range) skip its DMA and mask its whole score row;
+    the explicit p-zero guard keeps a dead round's exp(-inf - -inf)
+    from turning into ones before the slot's first live round.
+
+    The online-softmax state lives in VMEM SCRATCH (``m_ref``/``l_ref``
+    lane-broadcast (slots*H, 128), ``acc_ref`` (slots*H, Dh) — the same
+    layout discipline as the flash kernels) rather than in the fori
+    carry: a carry of 3*slots tiny (H, 1)-shaped arrays forces Mosaic
+    into per-iteration relayouts that cost ~50x the round's actual
+    compute (measured on v5e).
+    """
+    h = q_ref.shape[1]
+    hkv = kp_ref.shape[1]
+    dh = q_ref.shape[2]
+    quant = ks_ref is not None
+
+    length = [lens_ref[s] for s in range(slots)]
+    # live pages hold positions 0..len inclusive; clamp to the page
+    # table's width so a scheduler bug (a slot grown past its table) can
+    # never drive a DMA from an out-of-bounds table read — the state's
+    # alloc_failed flag is the error signal for that case
+    max_pages = table_ref.shape[1]
+    n_hi = [
+        jnp.minimum(length[s] // page + 1, max_pages) for s in range(slots)
+    ]
+    if window is None:
+        p_lo = [jnp.int32(0)] * slots
+    else:
+        p_lo = [
+            jnp.maximum(length[s] - (window - 1), 0) // page
+            for s in range(slots)
+        ]
+    lo, hi = p_lo[0], n_hi[0]
+    for s in range(1, slots):
+        lo = jnp.minimum(lo, p_lo[s])
+        hi = jnp.maximum(hi, n_hi[s])
+
+    def round_live(s, i):
+        return (i >= p_lo[s]) & (i < n_hi[s])
+
+    def start(i, buf):
+        for s in range(slots):
+            @pl.when(round_live(s, i))
+            def _(s=s):
+                pid = table_ref[s, i]
+                pltpu.make_async_copy(
+                    kp_ref.at[pid], kbuf.at[buf, s], sems.at[0, buf, s]
+                ).start()
+                pltpu.make_async_copy(
+                    vp_ref.at[pid], vbuf.at[buf, s], sems.at[1, buf, s]
+                ).start()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_ref.at[pid], ksbuf.at[buf, s], sems.at[2, buf, s]
+                    ).start()
+                    pltpu.make_async_copy(
+                        vs_ref.at[pid], vsbuf.at[buf, s], sems.at[3, buf, s]
+                    ).start()
+
+    def wait(i, buf):
+        for s in range(slots):
+            @pl.when(round_live(s, i))
+            def _(s=s):
+                pid = table_ref[s, i]
+                pltpu.make_async_copy(
+                    kp_ref.at[pid], kbuf.at[buf, s], sems.at[0, buf, s]
+                ).wait()
+                pltpu.make_async_copy(
+                    vp_ref.at[pid], vbuf.at[buf, s], sems.at[1, buf, s]
+                ).wait()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_ref.at[pid], ksbuf.at[buf, s], sems.at[2, buf, s]
+                    ).wait()
+                    pltpu.make_async_copy(
+                        vs_ref.at[pid], vsbuf.at[buf, s], sems.at[3, buf, s]
+                    ).wait()
+
+    start(lo, 0)
+    m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+    qs = [q_ref[s].astype(jnp.float32) for s in range(slots)]  # (H, Dh)
+
+    def body(i, _):
+        buf = jax.lax.rem(i - lo, 2)
+
+        @pl.when(i + 1 < hi)
+        def _():
+            start(i + 1, jax.lax.rem(i + 1 - lo, 2))
+
+        wait(i, buf)
+        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+
+        for s in range(slots):
+            rows = slice(s * h, (s + 1) * h)
+            m = m_ref[rows, :1]  # (H, 1); lanes hold copies
+            if quant:  # dequant right after the DMA: per-(head, token)
+                # scales broadcast over Dh; dots run f32
+                kpage = kbuf[buf, s].astype(jnp.float32) * (
+                    ksbuf[buf, s][:, None, :]
+                )
+                vpage = vbuf[buf, s].astype(jnp.float32) * (
+                    vsbuf[buf, s][:, None, :]
+                )
+            else:
+                # cache dtype (bf16) on the MXU with f32 accumulation,
+                # scores ROUNDED back to the cache dtype before the f32
+                # softmax — the exact dtype mix of the dense cache path
+                # in models.sequence.Block, so paged == dense to ULPs
+                kpage = kbuf[buf, s][...]
+                vpage = vbuf[buf, s][...]
+
+            live = (pos <= length[s]) & round_live(s, i)
+            if window is not None:
+                live = live & (pos > length[s] - window)
+
+            # per kv head: (G, Dh) x (Dh, page) -> (G, page) — the
+            # tokens-on-lanes pool layout feeds the dot directly; the
+            # group loop is static (GQA: q head h reads pool head h//G)
+            parts = []
+            for hh in range(hkv):
+                qh = qs[s][hh * group:(hh + 1) * group, :]
+                s_h = jax.lax.dot_general(
+                    qh.astype(kpage.dtype), kpage[hh],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                if not quant:
+                    s_h = s_h.astype(kpage.dtype).astype(jnp.float32)
+                parts.append(s_h * scale)
+            s_all = jnp.concatenate(parts, axis=0) if hkv > 1 else parts[0]
+            s_all = jnp.where(live, s_all, _NEG_INF)  # (H, page)
+
+            m_new = jnp.maximum(m, jnp.max(s_all, axis=-1, keepdims=True))
+            p = jnp.exp(s_all - m_new)
+            # before a slot's first live round m is still -inf and the
+            # fully-masked row would exp(0) to ones — zero it explicitly
+            p = jnp.where(s_all <= _NEG_INF / 2, 0.0, p)
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l_ref[rows] = jnp.broadcast_to(
+                l_ref[rows, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+                (h, l_ref.shape[1]),
+            )
+            pv_parts = []
+            for hh in range(hkv):  # (G, page) x (Dh, page) -> (G, Dh)
+                pv_parts.append(
+                    jax.lax.dot_general(
+                        # dense path casts softmax weights back to the
+                        # cache dtype before the PV matmul; match it
+                        p[hh * group:(hh + 1) * group, :].astype(
+                            vpage.dtype
+                        ),
+                        vpage[hh],
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+            pv = (
+                jnp.concatenate(pv_parts, axis=0) if hkv > 1 else pv_parts[0]
+            )
+            # dead rounds (window p_lo > global lo) never DMA'd this
+            # buffer: p is all-zero but vpage may be uninitialized NaN
+            # garbage, and 0 * NaN would poison the accumulator
+            pv = jnp.where(round_live(s, i), pv, 0.0)
+            acc_ref[rows] = acc_ref[rows] * alpha + pv
+            m_ref[rows] = jnp.broadcast_to(m_new, (h, m_ref.shape[1]))
+        return 0
+
+    jax.lax.fori_loop(lo, hi, body, 0)
+    for s in range(slots):
+        rows = slice(s * h, (s + 1) * h)
+        # position `length[s]` is always live, so l >= its probability
+        # > 0 — except in the table-overflow error case (alloc_failed
+        # set, every round clamped away); the floor keeps that 0/0 from
+        # minting NaNes into an output nobody should read
+        o_ref[s] = (
+            acc_ref[rows] / jnp.maximum(l_ref[rows, :1], 1e-37)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("window", "interpret"))
+def _paged_call(
+    q, k_pool, v_pool, page_table, lens, k_scale, v_scale, *, window,
+    interpret,
+):
+    slots, h, dh = q.shape
+    _, hkv, _, page = k_pool.shape
+    group = h // hkv
+    quant = k_scale is not None
+    scale = float(1.0 / (dh**0.5))
+
+    smem = pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM)
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
+    vmem = pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM)
+
+    scratch = [
+        pltpu.VMEM((2, slots, hkv, dh, page), k_pool.dtype),  # kbuf
+        pltpu.VMEM((2, slots, hkv, dh, page), v_pool.dtype),  # vbuf
+        pltpu.VMEM((2, slots, hkv, page), jnp.float32) if quant else None,
+        pltpu.VMEM((2, slots, hkv, page), jnp.float32) if quant else None,
+        pltpu.VMEM((slots * h, 128), jnp.float32),  # m (lane-broadcast)
+        pltpu.VMEM((slots * h, 128), jnp.float32),  # l
+        pltpu.VMEM((slots * h, dh), jnp.float32),   # acc
+        pltpu.SemaphoreType.DMA((4, 2, slots)),
+    ]
+    in_specs = [smem, smem, vmem, hbm, hbm]
+    args = [page_table, lens, q, k_pool, v_pool]
+    if quant:
+        in_specs += [hbm, hbm]
+        args += [k_scale, v_scale]
+
+    def kernel(table_ref, lens_ref, q_ref, kp_ref, vp_ref, *rest):
+        if quant:
+            ks_ref, vs_ref = rest[0], rest[1]
+            o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sems = (
+                rest[2:]
+            )
+        else:
+            ks_ref = vs_ref = ksbuf = vsbuf = None
+            (o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sems) = rest
+        _paged_kernel(
+            table_ref, lens_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+            o_ref, kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sems,
+            page=page, window=window, slots=slots, group=group,
+            scale=scale,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=in_specs,
+        out_specs=vmem,
+        out_shape=jax.ShapeDtypeStruct((slots, h, dh), q.dtype),
+        scratch_shapes=[sh for sh in scratch if sh is not None],
+        interpret=interpret,
+    )(*args)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lens: jax.Array,
+    *,
+    window: int | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Single-token decode attention over a paged KV pool, in place.
+
+    - ``q``: (S, H, Dh) — slot ``s``'s query for position ``lens[s]``
+      (whose kv column must already be scattered into the pool).
+    - ``k_pool``/``v_pool``: (N, Hkv, Dh, page) page pools — tokens on
+      the minor (lane) dim, see module docstring (bf16, or int8 with
+      ``k_scale``/``v_scale`` (N, Hkv, page) f32 per-token scales). On
+      real TPUs ``page`` must be a multiple of 128 (lane alignment for
+      the in-place page DMAs).
+    - ``page_table``: (S, P); entry ``(s, i)`` is the pool page holding
+      slot ``s``'s positions ``[i*page, (i+1)*page)``.
+    - ``lens``: (S,) — slot ``s`` attends positions ``0..lens[s]``
+      inclusive (minus anything at or before ``lens[s] - window``).
+
+    Returns (S, H, Dh) in q's dtype. Matches the dense cache path of
+    :class:`~beholder_tpu.models.sequence.Block` to float tolerance; no
+    dense (S, P*page) view of the cache ever materializes (pinned by
+    ``tests/test_paged_attention.py``).
+    """
+    if q.ndim != 3:
+        raise ValueError(f"q must be (slots, heads, head_dim), got {q.shape}")
+    slots, h, dh = q.shape
+    n, hkv, dh_p, page = k_pool.shape
+    if dh_p != dh:
+        raise ValueError(f"head_dim mismatch: q {dh} vs pool {dh_p}")
+    if not _interpret() and page % 128:
+        raise ValueError(
+            f"page size {page} must be a multiple of 128 on TPU (pages "
+            f"are lane-aligned token columns; pick page_size=128)"
+        )
+    if h % hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {hkv}")
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(f"pool shape mismatch: {k_pool.shape} vs {v_pool.shape}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None and k_scale.shape != (n, hkv, page):
+        raise ValueError(
+            f"scales must be {(n, hkv, page)}, got {k_scale.shape}"
+        )
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return _paged_call(
+        q, k_pool, v_pool, page_table.astype(jnp.int32),
+        lens.astype(jnp.int32), k_scale, v_scale, window=window,
+        interpret=_interpret(),
+    )

@@ -31,15 +31,29 @@ import jax.numpy as jnp
 _QKEYS = ("qvalues", "scale")
 
 
+def quantize_symmetric(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization reducing ``axis``: returns (q int8,
+    scale f32 with ``axis`` removed), ``x ≈ q * scale`` (scale
+    re-broadcast on ``axis``). One definition serves weights (per output
+    channel), KV page chunks (per token), and decode-tick columns — the
+    copies MUST stay numerically identical for paged-vs-dense cache
+    equivalence, so there is exactly one."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / jnp.expand_dims(scale, axis)),
+        -127, 127,
+    )
+    return q.astype(jnp.int8), scale
+
+
 def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:
     """(in, out) matmul kernel -> symmetric int8 with per-OUTPUT-channel
     scales. ``w ≈ qvalues.astype(f32) * scale``."""
     if w.ndim != 2:
         raise ValueError(f"expected a 2-D kernel, got shape {w.shape}")
-    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # (out,)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
-    return {"qvalues": q.astype(jnp.int8), "scale": scale}
+    q, scale = quantize_symmetric(w, axis=0)
+    return {"qvalues": q, "scale": scale}
 
 
 def dequantize_weight(q: dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:

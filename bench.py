@@ -510,6 +510,55 @@ def bench_flash_attention() -> dict:
     }
 
 
+def bench_ring_block() -> dict:
+    """The ring-attention LOCAL step on one chip: a rotated (q, kv)
+    block pair attended with global offsets — Pallas kernel vs the XLA
+    einsum block-attend it replaced (round-3 gap: the distributed path
+    ran at einsum rate while single-chip ran at kernel rate). Shapes are
+    one device's shard of a T=16k/8-device ring (2048 rows, d=128)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from beholder_tpu.ops import attention as A
+    from beholder_tpu.ops.flash_attention import flash_block_attend
+
+    b, h, hkv, t, d = 1, 8, 2, 2048, 128
+    q, k, v = (
+        jax.random.normal(
+            jax.random.PRNGKey(i), (b, hh, t, d), jnp.bfloat16
+        )
+        for i, hh in enumerate((h, hkv, hkv))
+    )
+    qo, ko = jnp.int32(4 * t), jnp.int32(2 * t)  # a mid-ring rotation
+
+    kernel = jax.jit(
+        lambda q, k, v, qo, ko: flash_block_attend(
+            q, k, v, causal=True, q_offset=qo, kv_offset=ko
+        )[0]
+    )
+    einsum = jax.jit(
+        lambda q, k, v, qo, ko: A._block_attend(
+            q, k, v, qo, ko, True
+        )[2]
+    )
+    t_kernel = _accel_timeit(kernel, q, k, v, qo, ko, reps=20)
+    t_einsum = _accel_timeit(einsum, q, k, v, qo, ko, reps=20)
+    flops = 4 * b * h * t * t * d  # fully-live rotated pair
+    return {
+        "metric": "ring_block_attend_tflops",
+        "value": round(flops / t_kernel / 1e12, 2),
+        "einsum_value": round(flops / t_einsum / 1e12, 2),
+        "kernel_speedup": round(t_einsum / t_kernel, 2),
+        "note": (
+            "one device's rotated block pair (T/P=2048, d=128, GQA 2/8) "
+            "with global-offset masks: Pallas kernel vs XLA einsum "
+            "block-attend"
+        ),
+    }
+
+
 def bench_decode() -> dict:
     """Serving: KV-cached autoregressive rollout throughput (prefill +
     lax.scan decode via forecast_deltas), bf16 weights vs int8
@@ -577,6 +626,78 @@ def bench_decode() -> dict:
     }
 
 
+def bench_serving(dense_tokens_per_sec: float | None) -> dict:
+    """Serving v2: paged + continuous batching throughput, measured on
+    the SAME model/shape as bench_decode (8 requests x 256-prefix x
+    128-horizon). One ``run_waves`` call = one batched prefill + one
+    compiled scan whose ticks attend the paged pool IN PLACE via the
+    Pallas decode kernel — the whole feedback loop stays on device.
+    Reported for bf16 and int8 page pools, with the pools' HBM bytes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+    from beholder_tpu.models.serving import ContinuousBatcher, Request
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    model = TelemetrySequenceModel(dim=512, heads=8, kv_heads=2, layers=4)
+    t, horizon, slots = 256, 128, 8
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), t, model=model)
+    params_bf16 = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 and x.ndim >= 2
+        else x,
+        state.params,
+    )
+    rng = np.random.default_rng(0)
+    requests = [
+        Request(
+            np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+            np.full(t + 1, int(TelemetryStatusEntry.CONVERTING)),
+            horizon,
+        )
+        for _ in range(slots)
+    ]
+
+    def measure(cache_dtype):
+        batcher = ContinuousBatcher(
+            model, params_bf16,
+            num_pages=slots * 3 + 8, page_size=128, slots=slots,
+            max_prefix=t, max_pages_per_seq=4, cache_dtype=cache_dtype,
+        )
+        batcher.run_waves(requests)  # compile admit + wave scan
+        best = float("inf")
+        for _ in range(3):
+            start = time.perf_counter()
+            batcher.run_waves(requests)
+            best = min(best, time.perf_counter() - start)
+        bytes_ = sum(
+            leaf.nbytes
+            for pool in batcher.state.k_pools + batcher.state.v_pools
+            for leaf in jax.tree.leaves(pool)
+        )
+        return slots * horizon / best, bytes_
+
+    bf16_rate, bf16_bytes = measure(jnp.bfloat16)
+    int8_rate, int8_bytes = measure("int8")
+    out = {
+        "metric": "paged_serving_tokens_per_sec",
+        "value": round(bf16_rate, 1),
+        "int8_value": round(int8_rate, 1),
+        "cache_mb": round(bf16_bytes / 2**20, 2),
+        "cache_int8_mb": round(int8_bytes / 2**20, 2),
+        "note": (
+            "8 x (256-prefix + 128-horizon) via run_waves: batched "
+            "prefill + one on-device scan; ticks read kv pages in place "
+            "(Pallas paged decode kernel)"
+        ),
+    }
+    if dense_tokens_per_sec:
+        out["vs_dense_rollout"] = round(bf16_rate / dense_tokens_per_sec, 2)
+    return out
+
+
 ACCEL_TIMEOUT_S = 1500  # flash + decode benches, cold-compile worst case
 
 
@@ -620,7 +741,9 @@ def main() -> None:
     if "--accel-only" in sys.argv:
         accel = bench_aggregation()
         accel["flash"] = bench_flash_attention()
+        accel["ring_block"] = bench_ring_block()
         accel["decode"] = bench_decode()
+        accel["serving"] = bench_serving(accel["decode"].get("value"))
         print(json.dumps(accel))
         return
 

@@ -1,0 +1,118 @@
+"""Paged decode attention kernel vs an independent numpy reference.
+
+Runs in Pallas interpreter mode on CPU — the same code path the TPU
+compiles (tests/conftest.py forces the cpu platform)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.ops.paged_attention import paged_decode_attention
+
+
+def _setup(seed=0, slots=4, h=8, hkv=2, dh=64, page=16, p_max=6, n=32):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(slots, h, dh)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n, hkv, dh, page)), jnp.bfloat16)
+    vp = jnp.asarray(rng.normal(size=(n, hkv, dh, page)), jnp.bfloat16)
+    perm = rng.permutation(n)[: slots * p_max].reshape(slots, p_max)
+    table = jnp.asarray(perm, jnp.int32)
+    lens = jnp.asarray(
+        rng.integers(0, p_max * page - 1, slots), jnp.int32
+    )
+    return q, kp, vp, perm, table, lens
+
+
+def _reference(q, kp, vp, perm, lens, window=None):
+    slots, h, dh = q.shape
+    hkv, page = kp.shape[1], kp.shape[3]
+    g = h // hkv
+    out = np.zeros((slots, h, dh), np.float32)
+    for s in range(slots):
+        n_ctx = int(lens[s]) + 1
+        npg = (n_ctx + page - 1) // page
+        k = np.concatenate(
+            [np.asarray(kp[perm[s, i]], np.float32) for i in range(npg)],
+            axis=2,
+        )[:, :, :n_ctx]
+        v = np.concatenate(
+            [np.asarray(vp[perm[s, i]], np.float32) for i in range(npg)],
+            axis=2,
+        )[:, :, :n_ctx]
+        for hq in range(h):
+            sc = (np.asarray(q[s, hq], np.float32) @ k[hq // g]) / np.sqrt(dh)
+            if window is not None:
+                pos = np.arange(n_ctx)
+                sc = np.where(pos > int(lens[s]) - window, sc, -1e30)
+            w = np.exp(sc - sc.max())
+            w /= w.sum()
+            out[s, hq] = v[hq // g] @ w
+    return out
+
+
+@pytest.mark.parametrize("window", [None, 24], ids=["full", "window"])
+def test_matches_reference(window):
+    q, kp, vp, perm, table, lens = _setup()
+    got = paged_decode_attention(q, kp, vp, table, lens, window=window)
+    np.testing.assert_allclose(
+        np.asarray(got), _reference(q, kp, vp, perm, lens, window),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_mqa_and_single_kv_head():
+    q, kp, vp, perm, table, lens = _setup(seed=1, h=4, hkv=1)
+    got = paged_decode_attention(q, kp, vp, table, lens)
+    np.testing.assert_allclose(
+        np.asarray(got), _reference(q, kp, vp, perm, lens),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_int8_pools_track_reference():
+    q, kp, vp, perm, table, lens = _setup(seed=2)
+    ks = jnp.abs(kp.astype(jnp.float32)).max(2).clip(1e-8) / 127.0
+    vs = jnp.abs(vp.astype(jnp.float32)).max(2).clip(1e-8) / 127.0
+    kq = jnp.clip(
+        jnp.round(kp.astype(jnp.float32) / ks[:, :, None, :]), -127, 127
+    ).astype(jnp.int8)
+    vq = jnp.clip(
+        jnp.round(vp.astype(jnp.float32) / vs[:, :, None, :]), -127, 127
+    ).astype(jnp.int8)
+    got = paged_decode_attention(
+        q, kq, vq, table, lens, k_scale=ks, v_scale=vs
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), _reference(q, kp, vp, perm, lens),
+        rtol=6e-2, atol=6e-2,
+    )
+
+
+def test_len_zero_slot_attends_only_position_zero():
+    """lens[s]=0 (a fresh slot's first token): only position 0 is live,
+    so the output is exactly v[:, :, 0] of the slot's first page."""
+    q, kp, vp, perm, table, lens = _setup(seed=3, slots=2)
+    lens = jnp.asarray([0, 40], jnp.int32)
+    got = np.asarray(paged_decode_attention(q, kp, vp, table, lens))
+    want0 = np.asarray(vp[perm[0, 0]], np.float32)[:, :, 0]  # (Hkv, Dh)
+    g = q.shape[1] // kp.shape[1]
+    for hq in range(q.shape[1]):
+        np.testing.assert_allclose(
+            got[0, hq], want0[hq // g], rtol=2e-2, atol=2e-2
+        )
+
+
+def test_validation_errors():
+    q, kp, vp, perm, table, lens = _setup(seed=4)
+    with pytest.raises(ValueError, match="slots, heads"):
+        paged_decode_attention(q[0], kp, vp, table, lens)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        paged_decode_attention(q[:, :5], kp, vp, table, lens)
+    with pytest.raises(ValueError, match="window"):
+        paged_decode_attention(q, kp, vp, table, lens, window=0)
+    with pytest.raises(ValueError, match="together"):
+        paged_decode_attention(
+            q, kp, vp, table, lens,
+            k_scale=jnp.ones((32, 2, 16)),
+        )

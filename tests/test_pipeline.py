@@ -393,3 +393,98 @@ def test_1f1b_composes_with_tp_inside_stages():
     assert grads["w1"].sharding.spec == P("pp", None, "tp")
     shard_shapes = {tuple(s.data.shape) for s in grads["w1"].addressable_shards}
     assert shard_shapes == {(1, dim, ff // 2)}
+
+
+# ---------------------------------------------------------------------------
+# the 1F1B SCHEDULE itself, measured (round-4: bubble_fraction stops being
+# documentation-only)
+# ---------------------------------------------------------------------------
+
+
+def _scan_lengths(jaxpr):
+    """All lax.scan trip counts anywhere in a (closed) jaxpr."""
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                found.append(eqn.params["length"])
+            for sub in eqn.params.values():
+                if hasattr(sub, "eqns"):
+                    walk(sub)
+                elif hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return found
+
+
+@pytest.mark.parametrize("m", [2, 6, 16])
+def test_1f1b_schedule_is_one_scan_of_m_plus_2s_ticks(pp_mesh, m):
+    """Structural pin of the schedule: the whole training step is ONE
+    scan of exactly M + 2(S-1) ticks (bubble_fraction's denominator) —
+    an accidental serialization (per-microbatch scans, nested scans, a
+    GPipe-style fill+drain of separate loops) changes this count."""
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    rng = np.random.default_rng(5)
+    s = STAGES
+    x = jnp.asarray(rng.normal(size=(m, 2, DIM)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(m, 2, DIM)), jnp.float32)
+    stacked = stack_stage_params(
+        make_stage_params(jax.random.PRNGKey(0))
+    )
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, x, y: pipeline_train_step(
+            stage_fn, mb_loss, p, x, y, pp_mesh
+        )
+    )(stacked, x, y)
+    lengths = _scan_lengths(jaxpr)
+    assert lengths == [m + 2 * (s - 1)], lengths
+
+
+def test_1f1b_wall_clock_tracks_tick_count(pp_mesh):
+    """Wall-clock evidence for the schedule: on the shared-core CPU mesh
+    total work is ticks x per-tick stage cost, so runtime across M must
+    scale like M + 2(S-1) — a serialized schedule (M*S ticks, or
+    M stage applications per tick) scales like M*S and blows the bound.
+    Dim is sized so per-tick matmuls dominate dispatch overhead."""
+    import time
+
+    from beholder_tpu.parallel.pipeline import pipeline_train_step
+
+    rng = np.random.default_rng(6)
+    s, dim, bm = STAGES, 256, 64
+    stacked = stack_stage_params(
+        make_stage_params(jax.random.PRNGKey(1), n_stages=s, dim=dim)
+    )
+
+    def timed(m):
+        x = jnp.asarray(rng.normal(size=(m, bm, dim)), jnp.float32)
+        y = jnp.asarray(rng.normal(size=(m, bm, dim)), jnp.float32)
+        f = jax.jit(
+            lambda p, x, y: pipeline_train_step(
+                stage_fn, mb_loss, p, x, y, pp_mesh
+            )
+        )
+        jax.block_until_ready(f(stacked, x, y))  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(stacked, x, y))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    m_small, m_big = 2, 18
+    t_small, t_big = timed(m_small), timed(m_big)
+    ticks = lambda m: m + 2 * (s - 1)
+    expected = ticks(m_big) / ticks(m_small)            # 3.0
+    serialized = (m_big * s) / (m_small * s)            # 9.0
+    ratio = t_big / t_small
+    # generous CI headroom around 3.0, but far below the 9.0 a
+    # serialized schedule would produce
+    assert ratio < (expected + serialized) / 2, (
+        f"1F1B runtime ratio {ratio:.2f} vs expected ~{expected:.1f} "
+        f"(serialized would be ~{serialized:.1f})"
+    )

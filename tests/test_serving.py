@@ -4,9 +4,12 @@ Two layers of pinning:
 
 - TEACHER-FORCED equivalence (tight): drive the paged primitives and the
   dense decode with the SAME preset inputs — no prediction feedback — so
-  per-tick outputs differ only by direct float-lowering ULPs (a (slots,)
-  batched matmul lowers differently than the dense path's B=1), never
-  amplified. The caches must agree to bf16 exactness.
+  per-tick outputs differ only by float-lowering ULPs. Since round 4 the
+  paged tick attends pages in place via the Pallas decode kernel, whose
+  ONLINE softmax (per-page m/l/acc combine, unnormalized probabilities
+  rounded to bf16 before the PV dot) reassociates what the dense path
+  computes as one full-row softmax — worth ~1-2 bf16 ULPs per layer,
+  never more (the score path's dtype mix is matched exactly in-kernel).
 - Product-level forecast (loose): the batcher feeds its own predictions
   back, so ULP differences amplify chaotically with horizon; the
   forecast is checked against ``forecast_deltas`` at rollout-chaos
@@ -95,23 +98,25 @@ def test_paged_decode_matches_dense_teacher_forced(model_kwargs):
         ft1 = jnp.concatenate([forced[tick][1][None, None], oh[None]], axis=-1)
         d0, c0 = decode_step(model, params, c0, ft0.astype(jnp.float32))
         d1, c1 = decode_step(model, params, c1, ft1.astype(jnp.float32))
-        # the (slots,) batched matmuls lower differently than the dense
-        # B=1 path; with bf16 params a single tick can differ by one
-        # bf16 ULP (~1e-3 at O(0.2)) without any state divergence
+        # ~1-2 bf16 ULPs per layer from the kernel's online-softmax
+        # reassociation (see module docstring); each tick's kv column
+        # carries the drift into the cache, so the bound grows linearly
+        # with ticks (a masking/indexing bug would blow past it by 10x+)
         np.testing.assert_allclose(
             np.asarray(preds), np.asarray(jnp.stack([d0[0], d1[0]])),
-            rtol=1e-2, atol=2e-3, err_msg=f"tick {tick}",
+            rtol=2e-2, atol=8e-3 + 4e-3 * tick, err_msg=f"tick {tick}",
         )
 
-    # caches agree everywhere written (bf16 storage on both paths)
-    k_views, v_views = sv._views(state)
+    # caches agree everywhere written (bf16 storage on both paths);
+    # slot_cache returns (Hkv, Dh, len), the dense cache (Hkv, L, Dh)
     for layer in range(model.layers):
         for slot, cache, t0 in ((0, c0, 13), (1, c1, 9)):
             ln = t0 + 12
+            k_slot, _ = sv.slot_cache(state, slot, layer)
             np.testing.assert_allclose(
-                np.asarray(k_views[layer][slot][:, :ln], np.float32),
+                np.asarray(k_slot, np.float32).transpose(0, 2, 1)[:, :ln],
                 np.asarray(cache.keys[layer][0][:, :ln], np.float32),
-                rtol=1e-2, atol=1e-3,
+                rtol=5e-2, atol=5e-2,  # layer>0 kv carries the ULP drift
             )
     assert not bool(state.alloc_failed)
 
@@ -152,7 +157,7 @@ def test_continuous_batcher_end_to_end():
         # first few steps are feedback-free enough to check tightly
         # (bf16-ULP tolerance; see the teacher-forced test)
         np.testing.assert_allclose(
-            results[i][:2], want[:2], rtol=1e-2, atol=2e-3,
+            results[i][:2], want[:2], rtol=3e-2, atol=1.5e-2,
             err_msg=f"request {i}",
         )
         np.testing.assert_allclose(
@@ -160,6 +165,147 @@ def test_continuous_batcher_end_to_end():
         )
     assert int(batcher.state.free_top) == 24  # every page came home
     assert not bool(batcher.state.active.any())
+
+
+def test_run_waves_matches_run():
+    """The on-device wave rollout (admit -> one compiled scan -> retire)
+    returns the same forecasts as the per-tick host loop, at mixed
+    horizons, with all pages recycled."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    requests = [
+        _request(0, t=24, horizon=5),
+        _request(1, t=9, horizon=12),
+        _request(2, t=17, horizon=3),
+        _request(3, t=30, horizon=8),
+        _request(4, t=5, horizon=0),
+    ]
+
+    def mk():
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=24, page_size=8, slots=2, max_prefix=32,
+            max_pages_per_seq=8,
+        )
+
+    got = mk().run_waves(requests)
+    want = mk().run(requests)
+    for i in range(len(requests)):
+        assert got[i].shape == want[i].shape
+        np.testing.assert_allclose(
+            got[i], want[i], rtol=1e-2, atol=2e-3, err_msg=f"request {i}"
+        )
+
+    b = mk()
+    b.run_waves(requests)
+    assert int(b.state.free_top) == 24
+    assert not bool(b.state.active.any())
+
+
+def test_run_waves_defers_ride_along_table_overflow():
+    """A short-horizon request riding a long-horizon wave member would
+    outgrow its own page table (round-4 review finding): the scheduler
+    must split them into separate waves, not crash mid-decode."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(3), 32, model=model)
+    # A(t=12, h=20) and B(t=25, h=2): at A's horizon B needs
+    # ceil((25+19)/8)=6 pages > max_pages_per_seq=4
+    requests = [_request(0, t=12, horizon=20), _request(1, t=25, horizon=2)]
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=24, page_size=8, slots=2, max_prefix=32,
+        max_pages_per_seq=4,
+    )
+    got = batcher.run_waves(requests)
+    for i, req in enumerate(requests):
+        want = np.asarray(
+            forecast_deltas(
+                model, state.params,
+                jnp.asarray(req.progress)[None],
+                jnp.asarray(req.statuses)[None], req.horizon,
+            )[0],
+            np.float32,
+        )
+        assert got[i].shape == want.shape
+        np.testing.assert_allclose(
+            got[i][:2], want[:2], rtol=3e-2, atol=1.5e-2
+        )
+    assert int(batcher.state.free_top) == 24
+
+
+def test_int8_cache_tracks_bf16_and_halves_bytes():
+    """cache_dtype=int8: forecasts track the bf16-cache batcher within
+    quantization tolerance and the pool's HBM bytes drop ~2x."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    requests = [_request(i, t=20, horizon=6) for i in range(3)]
+
+    def mk(dtype):
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=16, page_size=8, slots=2, max_prefix=32,
+            max_pages_per_seq=8, cache_dtype=dtype,
+        )
+
+    bf16 = mk(jnp.bfloat16)
+    int8 = mk("int8")
+    want = bf16.run_waves(requests)
+    got = int8.run_waves(requests)
+    for i in range(len(requests)):
+        np.testing.assert_allclose(
+            got[i][:2], want[i][:2], rtol=5e-2, atol=5e-2,
+            err_msg=f"request {i}",
+        )
+
+    def pool_bytes(state):
+        return sum(
+            leaf.nbytes
+            for pool in state.k_pools + state.v_pools
+            for leaf in jax.tree.leaves(pool)
+        )
+
+    bf16_bytes = pool_bytes(bf16.state)
+    int8_bytes = pool_bytes(int8.state)
+    # int8 values are half of bf16; the per-token f32 scales add
+    # 4B/(2B*Dh) back (Dh=16 here -> 12.5%, so 0.625x; 0.53x at the
+    # serving model's Dh=64)
+    assert int8_bytes < 0.65 * bf16_bytes, (int8_bytes, bf16_bytes)
+
+
+def test_tick_never_materializes_dense_views():
+    """The round-4 claim: the decode tick is paged at COMPUTE time. No
+    operation in the tick's jaxpr may produce a dense per-slot cache
+    view (slots, ..., max_pages*page, ...) or (..., max_pages*page, Dh)
+    — the pages are read in place by the Pallas kernel."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    slots, page, max_pages = 2, 8, 8
+    state = sv.init_paged(
+        model, num_pages=16, page_size=page, slots=slots,
+        max_pages_per_seq=max_pages,
+    )
+    feats_t = jnp.zeros((slots, 1 + NUM_STATUSES), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda p, s, f: sv.paged_decode_tick(model, p, s, f)
+    )(state0.params, state, feats_t)
+
+    span = max_pages * page
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = getattr(var.aval, "shape", ())
+                assert span not in shape, (
+                    f"dense {span}-wide cache view from {eqn.primitive}: "
+                    f"{shape}"
+                )
+            for sub in eqn.params.values():
+                if hasattr(sub, "eqns"):
+                    walk(sub)
+                elif hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
 
 
 def test_pool_memory_scales_with_tokens_not_slots():
