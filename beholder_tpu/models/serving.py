@@ -24,16 +24,28 @@ a CHANGING population of requests the way modern LLM servers do
   data-dependent Python in jit.
 - **Int8 KV cache** (``cache_dtype="int8"``): pages are stored int8 with
   per-(token, head) scales, dequantized inside the decode kernel — the
-  cache's HBM footprint AND the tick's page traffic halve vs bf16,
-  composing with GQA's kv-head shrink (same lever stack as vLLM + the
-  weight-only quant in :mod:`beholder_tpu.ops.quant`).
+  cache's HBM FOOTPRINT halves vs bf16, composing with GQA's kv-head
+  shrink (same capacity lever stack as vLLM + the weight-only quant in
+  :mod:`beholder_tpu.ops.quant`). Throughput is shape-dependent and
+  measured per round in BENCH_NOTES.md (~1.2x at the headline shape,
+  ~0.8x at long context where the kernel is issue-bound, not
+  bandwidth-bound) — int8's contract here is capacity, not speed.
 - **Continuous batching, two ways.** :meth:`ContinuousBatcher.run` is
   the flexible scheduler: admit queued requests into free slots
   mid-flight, tick all active slots together, retire finished ones. For
   fixed-horizon fleets :meth:`ContinuousBatcher.run_waves` fuses
-  admit -> scan(ticks) -> retire into compiled code — the prediction
-  feedback loop stays ON DEVICE inside one ``lax.scan`` (no per-token
-  host round-trip, the round-3 latency wall).
+  admit -> scan(ticks) -> retire into ONE compiled program per wave
+  (:func:`serve_wave`) — the prediction feedback loop stays ON DEVICE
+  inside one ``lax.scan`` (no per-token host round-trip, the round-3
+  latency wall).
+- **Zero mid-flight host readbacks** (round 5). On a tunneled
+  accelerator a single device->host read costs ~65 ms (measured; jit
+  dispatch is ~20 us) — round 4's "100x slower than dense" serving
+  number was ~11 such syncs per wave plus ~100 eager dispatches, not
+  kernel time. Both schedulers now keep every decision input on the
+  host (page headroom and retirement are host-arithmetic over request
+  lengths), build features in NumPy, and read results (plus the sticky
+  ``alloc_failed`` flag) back in ONE ``jax.device_get`` at the end.
 
 The paged decode is numerically equivalent to the dense per-request
 rollout (pinned by ``tests/test_serving.py``).
@@ -187,7 +199,14 @@ def paged_decode_tick(
         state.active, state.page_table[rows, pidx], num_pages  # OOB -> drop
     )
     info = PagedInfo(
-        state.page_table, state.seq_lens, write_pages,
+        state.page_table,
+        # inactive slots pass the -1 length sentinel: the kernel's live
+        # page range [p_lo, n_hi) is then empty, so dead slots issue NO
+        # page DMAs (round-4 advisor finding: a released slot's stale
+        # page_table row used to cost one wasted page DMA per layer per
+        # tick) and their rows are fully masked (output 0, ignored)
+        jnp.where(state.active, state.seq_lens, -1),
+        write_pages,
         state.seq_lens % page,
     )
 
@@ -345,6 +364,33 @@ def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
     )
 
 
+def paged_release_many(
+    state: PagedKVState, slot_ids: jax.Array
+) -> PagedKVState:
+    """Retire several (distinct) slots in one vectorized stack push —
+    the in-jit tail of :func:`serve_wave`. Inactive slots in
+    ``slot_ids`` contribute zero pages (their ``seq_lens`` is 0)."""
+    num_pages, page = _pool_geometry(state)
+    max_pages = state.page_table.shape[1]
+    n = slot_ids.shape[0]
+    counts = -(-state.seq_lens[slot_ids] // page)              # (n,)
+    alive = (
+        jax.lax.broadcasted_iota(jnp.int32, (n, max_pages), 1)
+        < counts[:, None]
+    ).reshape(-1)
+    rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    dest = jnp.where(alive, state.free_top + rank, num_pages)  # OOB drop
+    stack = state.free_stack.at[dest].set(
+        state.page_table[slot_ids].reshape(-1), mode="drop"
+    )
+    return state._replace(
+        free_stack=stack,
+        free_top=state.free_top + counts.sum(),
+        active=state.active.at[slot_ids].set(False, mode="drop"),
+        seq_lens=state.seq_lens.at[slot_ids].set(0, mode="drop"),
+    )
+
+
 def paged_wave(
     model: TelemetrySequenceModel,
     params,
@@ -374,6 +420,98 @@ def paged_wave(
     return deltas, state
 
 
+def serve_wave(
+    model: TelemetrySequenceModel,
+    params,
+    state: PagedKVState,
+    feats_padded: jax.Array,
+    prefix_lens: jax.Array,
+    last_statuses: jax.Array,
+    n_ticks: int,
+    horizons: tuple[int, ...] | None = None,
+):
+    """One whole serving wave as ONE compiled program: admit ``n``
+    requests into slots ``0..n-1`` (batched prefill), roll every slot
+    ``n_ticks`` feedback steps in one ``lax.scan``, then release the
+    wave's pages — a single dispatch with zero host round-trips (each
+    device->host read costs ~65 ms on a tunneled accelerator; see the
+    module docstring). ``feats_padded`` is (n, T_max, F),
+    ``prefix_lens``/``last_statuses`` are (n,). Returns
+    ((n, n_ticks + 1) forecast deltas, state) — or, with a static
+    ``horizons`` tuple, a tuple of per-request ``(horizons[i],)``
+    forecast arrays trimmed in-program."""
+    n = feats_padded.shape[0]
+    slots = state.page_table.shape[0]
+    slot_ids = jnp.arange(n, dtype=jnp.int32)
+    preds, state = paged_admit_batch(
+        model, params, state, slot_ids, feats_padded, prefix_lens
+    )
+    status_oh = (
+        jnp.zeros((slots, NUM_STATUSES), jnp.float32)
+        .at[:n]
+        .set(jax.nn.one_hot(last_statuses, NUM_STATUSES))
+    )
+    pred0 = jnp.zeros((slots,), jnp.float32).at[:n].set(
+        preds.astype(jnp.float32)
+    )
+    deltas, state = paged_wave(
+        model, params, state, pred0, status_oh, n_ticks
+    )
+    state = paged_release_many(state, slot_ids)
+    if horizons is not None:
+        # per-request trims INSIDE the program: an eager row slice after
+        # the fact costs an extra dispatch per request (~1 ms each over
+        # a tunnel), a traced slice is free
+        return tuple(deltas[i, : horizons[i]] for i in range(n)), state
+    return deltas[:n], state
+
+
+class _RunCarry(NamedTuple):
+    """Device-resident feedback state for :meth:`ContinuousBatcher.run`:
+    the per-tick scheduler never reads predictions back to the host, so
+    the loop inputs (last prediction, frozen status one-hot) and the
+    per-slot forecast accumulator live here."""
+
+    last_pred: jax.Array  # (slots,) f32
+    status_oh: jax.Array  # (slots, NUM_STATUSES) f32
+    delta_buf: jax.Array  # (slots, cap) f32; tick t writes column t
+
+
+def _admit_with_carry(
+    model, params, state, carry: _RunCarry, slot, feats_padded, prefix_len,
+    last_status,
+):
+    """Admit one request and record its prefill prediction + status
+    one-hot in the device carry (no values cross to the host)."""
+    pred, state = paged_admit(
+        model, params, state, slot, feats_padded, prefix_len
+    )
+    return state, carry._replace(
+        last_pred=carry.last_pred.at[slot].set(pred.astype(jnp.float32)),
+        status_oh=carry.status_oh.at[slot].set(
+            jax.nn.one_hot(last_status, NUM_STATUSES)
+        ),
+    )
+
+
+def _tick_with_carry(model, params, state, carry: _RunCarry, write_idx):
+    """One decode tick for all slots, feedback on device: append each
+    active slot's pending prediction to its forecast row (inactive
+    slots pass ``write_idx == cap`` so the write drops), build the tick
+    features from the carry, run the tick, store the new predictions."""
+    slots = carry.delta_buf.shape[0]
+    buf = carry.delta_buf.at[jnp.arange(slots), write_idx].set(
+        carry.last_pred, mode="drop"
+    )
+    feats_t = jnp.concatenate(
+        [carry.last_pred[:, None], carry.status_oh], axis=-1
+    )
+    preds, state = paged_decode_tick(model, params, state, feats_t)
+    return state, carry._replace(
+        last_pred=preds.astype(jnp.float32), delta_buf=buf
+    )
+
+
 class Request(NamedTuple):
     progress: np.ndarray   # (T+1,) observed progress
     statuses: np.ndarray   # (T+1,) observed statuses
@@ -384,11 +522,20 @@ class ContinuousBatcher:
     """Host-side vLLM-style scheduler over the paged state.
 
     Submit any number of :class:`Request`\\ s, then :meth:`run` (admit
-    into free slots as they open; one host round-trip per tick) or
-    :meth:`run_waves` (admit up to ``slots`` requests in ONE batched
-    prefill, roll the whole wave's horizon on device in one compiled
-    scan, retire, repeat — the throughput path). Results are per-request
-    forecast delta arrays, equal to the dense per-request rollout.
+    into free slots as they open; one fused tick dispatch per step,
+    zero mid-flight readbacks — the latency/flexibility path) or
+    :meth:`run_waves` (one compiled admit+scan+release program per wave
+    of up to ``slots`` requests — the throughput path; measured in
+    ``bench.py``). Results are per-request forecast delta arrays, equal
+    to the dense per-request rollout, read back from the device in ONE
+    transfer at the end of either scheduler.
+
+    Host-side admission math mirrors the device allocator exactly
+    (worst-case pages per request are a function of request lengths
+    only), so scheduling decisions never wait on the device; the sticky
+    ``alloc_failed`` flag is still checked once at the end as a safety
+    net. After an exhaustion error the batcher's pool state is
+    undefined — construct a fresh one.
     """
 
     def __init__(
@@ -414,17 +561,22 @@ class ContinuousBatcher:
             cache_dtype=cache_dtype,
         )
         self.slots = slots
-        self._tick = jax.jit(
-            lambda p, s, f: paged_decode_tick(model, p, s, f)
-        )
-        self._admit = jax.jit(
-            lambda p, s, slot, feats, ns: paged_admit_batch(
-                model, p, s, slot, feats, ns
+        self._release = jax.jit(paged_release)
+        self._admit_carry = jax.jit(
+            lambda p, s, c, slot, feats, n, st: _admit_with_carry(
+                model, p, s, c, slot, feats, n, st
             )
         )
-        self._release = jax.jit(paged_release)
-        # wave rollouts jit per horizon (the scan length is static)
-        self._wave_cache: dict[int, object] = {}
+        self._tick_carry = jax.jit(
+            lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
+        )
+        # serve_wave programs jit per (n, n_ticks, horizons) — the scan
+        # length and in-program trims are static
+        self._serve_cache: dict[tuple, object] = {}
+        # set when an exception escaped mid-flight: device state may
+        # hold admitted-but-unreleased pages, so the host's free-page
+        # arithmetic no longer mirrors the allocator
+        self._poisoned = False
 
     # -- shared helpers -------------------------------------------------
 
@@ -436,19 +588,29 @@ class ContinuousBatcher:
         tokens = feats_len + max(req.horizon - 1, 0)
         return -(-tokens // self.page_size)
 
-    def _prep(self, req: Request):
-        from .sequence import stream_features
-
-        feats, _ = stream_features(
-            jnp.asarray(req.progress)[None], jnp.asarray(req.statuses)[None]
-        )
-        t = feats.shape[1]
+    def _prep_np(self, req: Request):
+        """Pure-NumPy :func:`~.sequence.stream_features` for one request
+        — feature prep must not issue eager device ops (each would pay
+        tunnel latency). Returns ((t, F) feats, t); callers pad to the
+        page-aligned width they need (the WAVE's max, not the global
+        ``max_prefix`` — prefill cost then scales with the tokens
+        actually admitted, another place paging beats fixed-width
+        batches). Padding width is inert for correctness: prefill is
+        causal and only ceil(t/page) pages are written."""
+        deltas = np.diff(np.asarray(req.progress, np.float32))
+        oh = np.eye(NUM_STATUSES, dtype=np.float32)[
+            np.asarray(req.statuses[1:], np.int64)
+        ]
+        feats = np.concatenate([deltas[:, None], oh], axis=1)
+        t = feats.shape[0]
         if t > self.max_prefix:
             raise ValueError(
                 f"prefix {t} exceeds max_prefix {self.max_prefix}"
             )
-        padded = jnp.pad(feats, ((0, 0), (0, self.max_prefix - t), (0, 0)))
-        return padded, t
+        return feats, t
+
+    def _pad_to(self, feats: np.ndarray, width: int) -> np.ndarray:
+        return np.pad(feats, ((0, width - feats.shape[0]), (0, 0)))
 
     def _check_servable(self, req: Request):
         need = self._need_pages(req)
@@ -460,46 +622,92 @@ class ContinuousBatcher:
                 f"the horizon"
             )
 
+    def _start_run(self, requests: list[Request]):
+        """Fail fast BEFORE anything is admitted: every per-request
+        precondition (prefix cap, pool/table fit) is checked up front so
+        an unservable request cannot raise mid-flight with earlier
+        requests' pages still held. An exception that nevertheless
+        escapes mid-run (allocator safety net, device error) POISONS the
+        batcher — the host's free-page arithmetic would no longer mirror
+        the device allocator — and every later call refuses to run."""
+        if self._poisoned:
+            raise RuntimeError(
+                "batcher state undefined after an earlier mid-run error "
+                "— construct a fresh ContinuousBatcher"
+            )
+        for req in requests:
+            if req.horizon <= 0:
+                continue
+            t = len(req.progress) - 1
+            if t > self.max_prefix:
+                raise ValueError(
+                    f"prefix {t} exceeds max_prefix {self.max_prefix}"
+                )
+            self._check_servable(req)
+
     # -- flexible path: per-tick scheduling -----------------------------
 
     def run(self, requests: list[Request]) -> list[np.ndarray]:
+        """Per-tick scheduling with on-device feedback: each tick is ONE
+        fused dispatch (:func:`_tick_with_carry`); retirement snapshots
+        a slot's forecast row as a device array (async slice, no sync);
+        everything is read back in one ``jax.device_get`` at the end.
+
+        This is the latency/flexibility path — requests admit the tick a
+        slot frees up, so mixed-horizon fleets keep all slots busy. Its
+        per-tick host dispatch (~0.1-0.5 ms) caps throughput below
+        :meth:`run_waves`' fused scan; both are measured side by side in
+        ``bench.py`` (``serving.run_value`` vs ``serving.value``)."""
+        self._start_run(requests)
+        try:
+            return self._run(requests)
+        except BaseException:
+            self._poisoned = True
+            raise
+
+    def _run(self, requests: list[Request]) -> list[np.ndarray]:
         queue = list(enumerate(requests))
         results: list = [None] * len(requests)
-        # per-slot host bookkeeping
+        cap = max(
+            1, max((r.horizon for r in requests), default=1) - 1
+        )
+        carry = _RunCarry(
+            jnp.zeros((self.slots,), jnp.float32),
+            jnp.zeros((self.slots, NUM_STATUSES), jnp.float32),
+            jnp.zeros((self.slots, cap), jnp.float32),
+        )
+        # per-slot host bookkeeping (host mirrors the allocator: pages a
+        # request can ever hold depend only on its lengths)
         req_of = [None] * self.slots
-        deltas: list = [None] * self.slots
         remaining = np.zeros(self.slots, np.int64)
         total_need = np.zeros(self.slots, np.int64)  # pages at horizon end
-        cur_len = np.zeros(self.slots, np.int64)     # tokens written
-        last_pred = np.zeros(self.slots, np.float32)
-        status_oh = np.zeros((self.slots, NUM_STATUSES), np.float32)
+        written = np.zeros(self.slots, np.int64)     # forecast entries
+        snaps: dict[int, tuple] = {}  # rid -> (head | None, tail) on device
 
-        def committed() -> int:
-            """Pages active slots will STILL allocate: worst-case total
-            minus what they already hold (free_top already reflects held
-            pages, so subtracting total_need alone would double-count
-            growth that has materialized)."""
-            held = -(-cur_len // self.page_size)
-            return int(np.sum((total_need - held)[np.asarray(
-                [r is not None for r in req_of]
-            )]))
+        def free_pages() -> int:
+            """Free pages after honoring every active slot's worst-case
+            future growth (deferring admission beats the sticky
+            alloc_failed abort): num_pages minus the active worst
+            cases — held pages cancel between free_top and committed
+            growth, so no device read is needed."""
+            return self.num_pages - int(total_need.sum())
 
         def retire(slot):
-            """Collect the slot's final delta WITHOUT running another
-            tick (the horizon-th prediction is last_pred itself; a tick
-            for it could allocate a page for a token nobody reads)."""
-            deltas[slot].append(last_pred[slot])
-            results[req_of[slot]] = np.asarray(deltas[slot], np.float32)
+            """Snapshot the slot's forecast WITHOUT running another tick
+            (the horizon-th prediction is last_pred itself; a tick for
+            it could allocate a page for a token nobody reads). The
+            snapshot is an async device slice — fetched at the end."""
+            w = int(written[slot])
+            snaps[req_of[slot]] = (
+                carry.delta_buf[slot, :w] if w else None,
+                carry.last_pred[slot],
+            )
             self.state = self._release(self.state, jnp.int32(slot))
             req_of[slot] = None
             total_need[slot] = 0
-            cur_len[slot] = 0
+            written[slot] = 0
 
         while queue or any(r is not None for r in req_of):
-            # admit while there is a free slot, a queued request, AND
-            # enough free-page headroom after honoring every active
-            # slot's worst-case future growth (deferring beats the
-            # sticky alloc_failed abort)
             for slot in range(self.slots):
                 if not queue or req_of[slot] is not None:
                     continue
@@ -512,7 +720,7 @@ class ContinuousBatcher:
                     continue
                 self._check_servable(req)
                 need = self._need_pages(req)
-                free = int(self.state.free_top) - committed()
+                free = free_pages()
                 if need > free:
                     if not any(r is not None for r in req_of):
                         raise RuntimeError(
@@ -522,77 +730,115 @@ class ContinuousBatcher:
                         )
                     break  # defer until an active request retires
                 queue.pop(0)
-                padded, t = self._prep(req)
-                pred, self.state = self._admit(
-                    self.params, self.state,
-                    jnp.asarray([slot], jnp.int32), padded,
-                    jnp.asarray([t], jnp.int32),
+                feats_np, t = self._prep_np(req)
+                t_pad = -(-t // self.page_size) * self.page_size
+                self.state, carry = self._admit_carry(
+                    self.params, self.state, carry, jnp.int32(slot),
+                    jnp.asarray(self._pad_to(feats_np, t_pad))[None],
+                    jnp.int32(t),
+                    jnp.int32(int(req.statuses[-1])),
                 )
-                if bool(self.state.alloc_failed):
-                    raise RuntimeError(
-                        "page pool exhausted — raise num_pages or lower "
-                        "concurrency"
-                    )
                 req_of[slot] = rid
-                deltas[slot] = []
                 remaining[slot] = req.horizon
                 total_need[slot] = need
-                cur_len[slot] = t
-                last_pred[slot] = float(pred[0])
-                status_oh[slot] = np.asarray(
-                    jax.nn.one_hot(int(req.statuses[-1]), NUM_STATUSES)
-                )
+                written[slot] = 0
                 if remaining[slot] == 1:
                     retire(slot)  # the admit prediction was the forecast
 
             if not any(r is not None for r in req_of):
                 continue
 
-            # one compiled tick for every slot (inactive slots ride along)
-            feats_t = jnp.asarray(
-                np.concatenate([last_pred[:, None], status_oh], axis=1),
-                jnp.float32,
+            # one fused tick for every slot (inactive slots ride along;
+            # their forecast write drops at the cap sentinel)
+            write_idx = np.where(
+                [r is not None for r in req_of], written, cap
+            ).astype(np.int32)
+            self.state, carry = self._tick_carry(
+                self.params, self.state, carry, jnp.asarray(write_idx)
             )
-            preds, self.state = self._tick(self.params, self.state, feats_t)
-            if bool(self.state.alloc_failed):
-                raise RuntimeError("page pool exhausted mid-decode")
-            preds = np.asarray(preds)
-
             for slot in range(self.slots):
                 if req_of[slot] is None:
                     continue
-                deltas[slot].append(last_pred[slot])
-                last_pred[slot] = preds[slot]
+                written[slot] += 1
                 remaining[slot] -= 1
-                cur_len[slot] += 1  # the tick wrote this slot's token
                 if remaining[slot] <= 1:
                     retire(slot)
+
+        # ONE host readback: the allocator flag plus every snapshot
+        flat: list = [self.state.alloc_failed]
+        for head, tail in snaps.values():
+            flat.append(tail)
+            if head is not None:
+                flat.append(head)
+        got = jax.device_get(flat)
+        if got[0]:
+            raise RuntimeError(
+                "page pool exhausted mid-run (device allocator tripped "
+                "despite host headroom checks) — raise num_pages"
+            )
+        i = 1
+        for rid, (head, _) in snaps.items():
+            tail_v = np.float32(got[i])
+            i += 1
+            if head is not None:
+                results[rid] = np.append(
+                    np.asarray(got[i], np.float32), tail_v
+                )
+                i += 1
+            else:
+                results[rid] = np.asarray([tail_v], np.float32)
         return results
 
     # -- throughput path: on-device waves -------------------------------
 
-    def _wave_fn(self, n_ticks: int):
-        fn = self._wave_cache.get(n_ticks)
+    def _serve_fn(
+        self, n: int, n_ticks: int, horizons: tuple[int, ...] | None = None
+    ):
+        key = (n, n_ticks, horizons)
+        fn = self._serve_cache.get(key)
         if fn is None:
             fn = jax.jit(
-                lambda p, s, pred, oh: paged_wave(
-                    self.model, p, s, pred, oh, n_ticks
+                lambda p, s, f, ln, st: serve_wave(
+                    self.model, p, s, f, ln, st, n_ticks,
+                    horizons=horizons,
                 )
             )
-            self._wave_cache[n_ticks] = fn
+            self._serve_cache[key] = fn
         return fn
 
-    def run_waves(self, requests: list[Request]) -> list[np.ndarray]:
+    def run_waves(
+        self, requests: list[Request], device_results: bool = False
+    ) -> list:
         """Fixed-horizon throughput mode: greedy waves of up to ``slots``
-        requests, each wave = one batched prefill + ONE compiled scan
-        over its max horizon (shorter-horizon members ride along; their
-        surplus deltas are dropped host-side). Page headroom is checked
-        per wave, with ride-along growth counted at the wave horizon."""
+        requests, each wave ONE compiled admit+scan+release program
+        (:func:`serve_wave`) over its max horizon (shorter-horizon
+        members ride along; their surplus deltas are dropped when
+        results are read back). Page headroom is checked per wave with
+        host arithmetic (no device reads), with ride-along growth
+        counted at the wave horizon.
+
+        With ``device_results=True`` the per-request forecasts come back
+        as device arrays with NO host readback at all — the pipelining /
+        benchmarking mode; the caller owns checking
+        ``state.alloc_failed`` before trusting them."""
+        self._start_run(requests)
+        try:
+            return self._run_waves(requests, device_results)
+        except BaseException:
+            self._poisoned = True
+            raise
+
+    def _run_waves(
+        self, requests: list[Request], device_results: bool
+    ) -> list:
         results: list = [None] * len(requests)
         queue = list(enumerate(requests))
+        batches: list = []  # (wave members, (n, h) deltas device array)
         while queue:
             wave: list = []
-            free = int(self.state.free_top)
+            # serve_wave releases everything it admits, so every wave
+            # starts from a full pool
+            free = self.num_pages
             horizon = 0
             while queue and len(wave) < self.slots:
                 rid, req = queue[0]
@@ -601,7 +847,6 @@ class ContinuousBatcher:
                     results[rid] = np.zeros(0, np.float32)
                     continue
                 self._check_servable(req)
-                t = len(req.progress) - 1
                 h = max(horizon, req.horizon)
                 # wave members decode h-1 ticks regardless of their own
                 # horizon, so BOTH headroom checks run at the wave's
@@ -632,31 +877,48 @@ class ContinuousBatcher:
             if not wave:
                 continue
 
-            prepped = [self._prep(req) for _, req in wave]
-            feats = jnp.concatenate([p for p, _ in prepped], axis=0)
-            lens = jnp.asarray([t for _, t in prepped], jnp.int32)
-            slot_ids = jnp.arange(len(wave), dtype=jnp.int32)
-            preds, self.state = self._admit(
-                self.params, self.state, slot_ids, feats, lens
+            prepped = [self._prep_np(req) for _, req in wave]
+            t_pad = -(
+                -max(t for _, t in prepped) // self.page_size
+            ) * self.page_size
+            feats = np.stack([self._pad_to(p, t_pad) for p, _ in prepped])
+            lens = np.asarray([t for _, t in prepped], np.int32)
+            stats = np.asarray(
+                [int(req.statuses[-1]) for _, req in wave], np.int32
             )
-            if bool(self.state.alloc_failed):
-                raise RuntimeError("page pool exhausted during admit")
-            oh = np.zeros((self.slots, NUM_STATUSES), np.float32)
-            pred0 = np.zeros(self.slots, np.float32)
-            for i, (_, req) in enumerate(wave):
-                oh[i] = np.asarray(
-                    jax.nn.one_hot(int(req.statuses[-1]), NUM_STATUSES)
-                )
-                pred0[i] = float(preds[i])
+            horizons = (
+                tuple(req.horizon for _, req in wave)
+                if device_results
+                else None
+            )
+            deltas, self.state = self._serve_fn(
+                len(wave), horizon - 1, horizons
+            )(
+                self.params, self.state, jnp.asarray(feats),
+                jnp.asarray(lens), jnp.asarray(stats),
+            )
+            batches.append((wave, deltas))
 
-            deltas, self.state = self._wave_fn(horizon - 1)(
-                self.params, self.state, jnp.asarray(pred0),
-                jnp.asarray(oh),
+        if device_results:
+            # each wave's deltas is already a tuple of per-request
+            # in-program-trimmed arrays — no eager slicing here
+            for wave, rows in batches:
+                for (rid, _), row in zip(wave, rows):
+                    results[rid] = row
+            return results
+
+        # ONE host readback for all waves' results + the allocator flag
+        fetched = jax.device_get(
+            [d for _, d in batches] + [self.state.alloc_failed]
+        )
+        if fetched[-1]:
+            raise RuntimeError(
+                "page pool exhausted (device allocator tripped despite "
+                "host headroom checks) — raise num_pages"
             )
-            if bool(self.state.alloc_failed):
-                raise RuntimeError("page pool exhausted mid-decode")
-            deltas = np.asarray(deltas, np.float32)
+        for (wave, _), arr in zip(batches, fetched):
             for i, (rid, req) in enumerate(wave):
-                results[rid] = deltas[i, : req.horizon]
-                self.state = self._release(self.state, jnp.int32(i))
+                results[rid] = np.asarray(
+                    arr[i, : req.horizon], np.float32
+                )
         return results

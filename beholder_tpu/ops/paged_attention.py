@@ -21,7 +21,12 @@ Kernel design:
   dynamic ``fori_loop`` over pages per slot) — there is no per-slot grid
   step, so the whole tick pays ONE kernel dispatch per layer. Decode at
   telemetry-model sizes is latency-bound; grid-step fixed costs would
-  dominate a (slots, pages) grid.
+  dominate a (slots, pages) grid. The flip side: trace/compile time,
+  Mosaic code size, and the semaphore array all grow LINEARLY with the
+  slot count, so the design is sized for slot counts in the tens
+  (benchmarked at 8; compiles were still comfortable at 16). Past ~32
+  slots, move slots onto a grid dimension instead of widening the
+  unroll.
 - The page table and lengths ride SMEM (they index the DMAs; the scalar
   core reads them directly).
 - The online-softmax state (m, l, acc) is a tiny per-slot register
@@ -35,8 +40,13 @@ Kernel design:
 - Int8 pools (``k_scale``/``v_scale`` given): pages are stored int8 with
   per-(token, head) float32 scales and dequantized IN the kernel right
   after the DMA — int8 is the HBM-resident representation, so the
-  serving-memory wall AND decode bandwidth halve vs bf16 (the same
-  argument :mod:`beholder_tpu.ops.quant` makes for weights).
+  cache's HBM FOOTPRINT halves vs bf16 (the capacity lever; composes
+  with GQA). The throughput effect is shape-dependent and measured, not
+  assumed: at the headline serving shape int8 decode runs ~1.2x bf16
+  (BENCH r05 ``serving.int8_value``), but at long context the kernel is
+  DMA-issue/VPU-bound, not bandwidth-bound, and the in-kernel dequant
+  makes int8 ~0.8x there (``serving.long_context_t3584``) — see
+  BENCH_NOTES.md for the attribution.
 - Pool layout is (N, Hkv, Dh, page) — TOKENS ON LANES. Mosaic requires
   HBM DMA slices to be lane-aligned (128) on the minor dim; head dims
   are 64-ish but a page of tokens is naturally 128+, and this layout is
@@ -205,13 +215,18 @@ def _paged_kernel(
             rows = slice(s * h, (s + 1) * h)
             m = m_ref[rows, :1]  # (H, 1); lanes hold copies
             if quant:  # dequant right after the DMA: per-(head, token)
-                # scales broadcast over Dh; dots run f32
-                kpage = kbuf[buf, s].astype(jnp.float32) * (
-                    ksbuf[buf, s][:, None, :]
-                )
-                vpage = vbuf[buf, s].astype(jnp.float32) * (
-                    vsbuf[buf, s][:, None, :]
-                )
+                # scales broadcast over Dh. Dequantized pages are cast
+                # to bf16 so BOTH dots run at bf16 MXU rate (an f32 dot
+                # costs ~4 MXU passes). bf16 rounding is noise next to
+                # the int8 quantization error already present.
+                kpage = (
+                    kbuf[buf, s].astype(jnp.float32)
+                    * ksbuf[buf, s][:, None, :]
+                ).astype(jnp.bfloat16)
+                vpage = (
+                    vbuf[buf, s].astype(jnp.float32)
+                    * vsbuf[buf, s][:, None, :]
+                ).astype(jnp.bfloat16)
             else:
                 # cache dtype (bf16) on the MXU with f32 accumulation,
                 # scores ROUNDED back to the cache dtype before the f32
@@ -369,6 +384,11 @@ def paged_decode_attention(
       slot ``s``'s positions ``[i*page, (i+1)*page)``.
     - ``lens``: (S,) — slot ``s`` attends positions ``0..lens[s]``
       inclusive (minus anything at or before ``lens[s] - window``).
+      ``lens[s] == -1`` marks a DEAD slot: its live page range is empty,
+      so it issues no page DMAs at all (the scheduler passes this for
+      released slots whose stale ``page_table`` rows would otherwise
+      cost one wasted page DMA per layer per tick) and its output row is
+      all zeros.
 
     Returns (S, H, Dh) in q's dtype. Matches the dense cache path of
     :class:`~beholder_tpu.models.sequence.Block` to float tolerance; no

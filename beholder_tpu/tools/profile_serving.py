@@ -1,0 +1,193 @@
+"""Step-level serving profile (round 5, VERDICT task 1).
+
+Attributes paged-serving wall time on the real accelerator with two
+tunnel-robust methods:
+
+- SLOPE timing: run k chained device calls then ONE host readback; the
+  per-call cost is the slope between k=2 and k=10, which cancels both
+  the readback constant and dispatch latency. ``block_until_ready`` is
+  NOT trusted here — on the axon tunnel it returns early for some
+  programs (measured: a 127-tick scan "completed" in 0.3 ms against a
+  3.4 ms HBM roofline).
+- Latency probes: one-off costs of a jit dispatch, an eager op, an h2d
+  copy, and a d2h readback (the ~65 ms constant that produced round 4's
+  100x serving regression — see BENCH_NOTES.md).
+
+Run: ``python -m beholder_tpu.tools.profile_serving``
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _slope(fn, n1: int = 2, n2: int = 10) -> float:
+    """Marginal per-call seconds of ``fn(k)`` (k chained calls + one
+    readback): (T(n2) - T(n1)) / (n2 - n1), best of two rounds each."""
+    fn(2)  # warm/compile
+    t1 = min(fn(n1) for _ in range(2))
+    t2 = min(fn(n2) for _ in range(2))
+    return (t2 - t1) / (n2 - n1)
+
+
+def probe_latencies() -> dict[str, float]:
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((1024,))
+    jax.block_until_ready(f(x))
+
+    def best(fn, n=10):
+        out = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            out = min(out, time.perf_counter() - t0)
+        return out
+
+    return {
+        "jit_dispatch_ms": best(lambda: f(x)) * 1e3,
+        "eager_op_ms": best(
+            lambda: jax.block_until_ready(jnp.zeros((8,)) + 1)
+        ) * 1e3,
+        "h2d_8kb_ms": best(
+            lambda: jax.block_until_ready(jnp.asarray(np.zeros(1024)))
+        ) * 1e3,
+        "d2h_readback_ms": best(lambda: float(np.asarray(f(x)[:1])[0]))
+        * 1e3,
+    }
+
+
+def profile_serving() -> dict[str, float]:
+    from beholder_tpu.models import (
+        TelemetrySequenceModel,
+        forecast_deltas,
+        init_seq_state,
+    )
+    from beholder_tpu.models.serving import (
+        ContinuousBatcher,
+        Request,
+        init_paged,
+        paged_admit_batch,
+        paged_wave,
+        serve_wave,
+    )
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    model = TelemetrySequenceModel(dim=512, heads=8, kv_heads=2, layers=4)
+    t, horizon, slots = 256, 128, 8
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), t, model=model)
+    params = jax.tree.map(
+        lambda p: p.astype(jnp.bfloat16)
+        if p.dtype == jnp.float32 and p.ndim >= 2
+        else p,
+        state.params,
+    )
+    rng = np.random.default_rng(0)
+    out: dict[str, float] = {}
+
+    # fused serve_wave program (admit + 127-tick scan + release)
+    serve = jax.jit(
+        lambda p, s, f, ln, st: serve_wave(
+            model, p, s, f, ln, st, horizon - 1
+        )
+    )
+    pstate0 = init_paged(model, 32, 128, slots, 4)
+    feats = jnp.asarray(rng.normal(size=(slots, t, 7)), jnp.float32)
+    lens = jnp.full((slots,), t, jnp.int32)
+    stats = jnp.full(
+        (slots,), int(TelemetryStatusEntry.CONVERTING), jnp.int32
+    )
+
+    def run_serve(k):
+        s = pstate0
+        t0 = time.perf_counter()
+        d = None
+        for _ in range(k):
+            d, s = serve(params, s, feats, lens, stats)
+        float(np.asarray(d)[0, 0])
+        return time.perf_counter() - t0
+
+    out["serve_wave_program_ms"] = _slope(run_serve) * 1e3
+
+    # wave scan alone (admitted state held fixed)
+    admit = jax.jit(
+        lambda p, s, si, f, n: paged_admit_batch(model, p, s, si, f, n)
+    )
+    pred0, pstate1 = admit(
+        params, pstate0, jnp.arange(slots, dtype=jnp.int32), feats, lens
+    )
+    oh = jnp.zeros((slots, 6))
+    wave = jax.jit(
+        lambda p, s, pr, o: paged_wave(model, p, s, pr, o, horizon - 1)
+    )
+
+    def run_wave(k):
+        t0 = time.perf_counter()
+        d = None
+        for _ in range(k):
+            d, _ = wave(params, pstate1, pred0, oh)
+        float(np.asarray(d)[0, 0])
+        return time.perf_counter() - t0
+
+    out["wave_scan_program_ms"] = _slope(run_wave) * 1e3
+    out["us_per_tick"] = out["wave_scan_program_ms"] / (horizon - 1) * 1e3
+
+    # full host path (what bench_serving times)
+    reqs = [
+        Request(
+            np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+            np.full(t + 1, int(TelemetryStatusEntry.CONVERTING)),
+            horizon,
+        )
+        for _ in range(slots)
+    ]
+    b = ContinuousBatcher(
+        model, params, num_pages=32, page_size=128, slots=slots,
+        max_prefix=t, max_pages_per_seq=4,
+    )
+    b.run_waves(reqs)
+
+    def run_rw(k):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(k):
+            o = b.run_waves(reqs, device_results=True)
+        float(np.asarray(o[-1])[0])
+        return time.perf_counter() - t0
+
+    out["run_waves_host_path_ms"] = _slope(run_rw) * 1e3
+
+    # the dense rollout it is compared against
+    prog = jnp.asarray(
+        np.cumsum(1.0 + rng.normal(0, 0.05, (slots, t + 1)), axis=-1)
+    )
+    sts = jnp.full((slots, t + 1), TelemetryStatusEntry.CONVERTING)
+    roll = jax.jit(
+        lambda p, pr, st: forecast_deltas(model, p, pr, st, horizon)
+    )
+
+    def run_roll(k):
+        t0 = time.perf_counter()
+        d = None
+        for _ in range(k):
+            d = roll(params, prog, sts)
+        float(np.asarray(d)[0, 0])
+        return time.perf_counter() - t0
+
+    out["dense_rollout_program_ms"] = _slope(run_roll) * 1e3
+    return out
+
+
+def main() -> None:
+    print("latency probes:", {
+        k: round(v, 3) for k, v in probe_latencies().items()
+    })
+    for k, v in profile_serving().items():
+        print(f"{k}: {v:.2f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -629,10 +629,24 @@ def bench_decode() -> dict:
 def bench_serving(dense_tokens_per_sec: float | None) -> dict:
     """Serving v2: paged + continuous batching throughput, measured on
     the SAME model/shape as bench_decode (8 requests x 256-prefix x
-    128-horizon). One ``run_waves`` call = one batched prefill + one
-    compiled scan whose ticks attend the paged pool IN PLACE via the
-    Pallas decode kernel — the whole feedback loop stays on device.
-    Reported for bf16 and int8 page pools, with the pools' HBM bytes."""
+    128-horizon). One ``run_waves`` call = one compiled
+    admit+scan+release program whose ticks attend the paged pool IN
+    PLACE via the Pallas decode kernel — the whole feedback loop stays
+    on device.
+
+    Round-5 methodology fix: timed with the SAME amortized-readback
+    discipline as the dense rollout (``_accel_timeit`` over
+    ``run_waves(device_results=True)``), because on this tunneled
+    accelerator a single device->host read costs ~65 ms — round 4's
+    714 tok/s (vs_dense 0.01) was ~11 such reads per wave plus ~100
+    eager host dispatches, not device time (profiled in
+    BENCH_NOTES.md; the scheduler now makes zero mid-flight reads).
+
+    Also reported: the per-tick ``run()`` scheduler on the same
+    workload (the latency/flexibility path — one fused dispatch per
+    tick plus its own single end-of-run readback), and a long-context
+    decode shape (T=4096) where page traffic, not weights, bounds the
+    tick — the shape that tests the int8 pools' bandwidth claim."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -651,27 +665,39 @@ def bench_serving(dense_tokens_per_sec: float | None) -> dict:
         state.params,
     )
     rng = np.random.default_rng(0)
-    requests = [
-        Request(
-            np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
-            np.full(t + 1, int(TelemetryStatusEntry.CONVERTING)),
-            horizon,
+
+    def mk_requests(n, prefix, hor):
+        return [
+            Request(
+                np.cumsum(1.0 + rng.normal(0, 0.05, prefix + 1)),
+                np.full(prefix + 1, int(TelemetryStatusEntry.CONVERTING)),
+                hor,
+            )
+            for _ in range(n)
+        ]
+
+    requests = mk_requests(slots, t, horizon)
+
+    def mk_batcher(cache_dtype, num_pages=slots * 3 + 8, max_prefix=t,
+                   max_pages=4):
+        return ContinuousBatcher(
+            model, params_bf16,
+            num_pages=num_pages, page_size=128, slots=slots,
+            max_prefix=max_prefix, max_pages_per_seq=max_pages,
+            cache_dtype=cache_dtype,
         )
-        for _ in range(slots)
-    ]
 
     def measure(cache_dtype):
-        batcher = ContinuousBatcher(
-            model, params_bf16,
-            num_pages=slots * 3 + 8, page_size=128, slots=slots,
-            max_prefix=t, max_pages_per_seq=4, cache_dtype=cache_dtype,
+        batcher = mk_batcher(cache_dtype)
+        batcher.run_waves(requests)  # compile + end-to-end path once
+        # the timed fn returns the LAST wave's deltas only: dispatch is
+        # serialized, so its readback covers every wave's compute while
+        # costing exactly one d2h crossing — the same one-leaf readback
+        # shape _accel_timeit charges the dense rollout
+        best = _accel_timeit(
+            lambda: batcher.run_waves(requests, device_results=True)[-1],
+            reps=5,
         )
-        batcher.run_waves(requests)  # compile admit + wave scan
-        best = float("inf")
-        for _ in range(3):
-            start = time.perf_counter()
-            batcher.run_waves(requests)
-            best = min(best, time.perf_counter() - start)
         bytes_ = sum(
             leaf.nbytes
             for pool in batcher.state.k_pools + batcher.state.v_pools
@@ -681,21 +707,258 @@ def bench_serving(dense_tokens_per_sec: float | None) -> dict:
 
     bf16_rate, bf16_bytes = measure(jnp.bfloat16)
     int8_rate, int8_bytes = measure("int8")
+
+    # the flexible per-tick scheduler on the same workload (its own
+    # end-of-run readback is part of the honest figure: run() cannot
+    # defer it, that is the price of per-tick scheduling flexibility)
+    batcher = mk_batcher(jnp.bfloat16)
+    batcher.run(requests)
+    t_run = _accel_timeit(lambda: np.float64(batcher.run(requests)[0][0]),
+                          reps=2)
+    run_rate = slots * horizon / t_run
+
+    # long-context decode: T~3700 resident tokens per slot -> per-tick
+    # page traffic (~15 MB/layer bf16) dominates the weight stream
+    # (5.5 MB/layer); int8 pools halve exactly the dominant term. The
+    # wave scan is timed alone (prefill excluded — int8 does not claim
+    # to speed prefill) via the serving primitives. page_size=512: at
+    # page 128 the kernel walks 30 page rounds per slot and is
+    # DMA-ISSUE-bound (scalar core), which bandwidth halving cannot
+    # help; 512-token pages make it bandwidth-bound as intended.
+    from beholder_tpu.models.sequence import stream_features
+    from beholder_tpu.models.serving import (
+        init_paged,
+        paged_admit_batch,
+        paged_wave,
+    )
+    from beholder_tpu.ops import NUM_STATUSES
+
+    t_long, page_long = 3584, 512  # 7 pages; +127 ticks tops out page 8
+    prog = np.cumsum(
+        1.0 + rng.normal(0, 0.05, (slots, t_long + 1)), axis=-1
+    )
+    stats = np.full((slots, t_long + 1), int(TelemetryStatusEntry.CONVERTING))
+    feats, _ = stream_features(jnp.asarray(prog), jnp.asarray(stats))
+    oh = jnp.asarray(
+        np.tile(
+            np.eye(NUM_STATUSES, dtype=np.float32)[
+                int(TelemetryStatusEntry.CONVERTING)
+            ],
+            (slots, 1),
+        )
+    )
+    long_rates = {}
+    for name, dtype in (("bf16", jnp.bfloat16), ("int8", "int8")):
+        pstate = init_paged(
+            model, slots * 8, page_long, slots, 8, cache_dtype=dtype
+        )
+        admit = jax.jit(
+            lambda p, s, si, f, n: paged_admit_batch(model, p, s, si, f, n)
+        )
+        pred0, pstate = admit(
+            params_bf16, pstate, jnp.arange(slots, dtype=jnp.int32),
+            feats, jnp.full((slots,), t_long, jnp.int32),
+        )
+        wave = jax.jit(
+            lambda p, s, pr, o: paged_wave(model, p, s, pr, o, horizon - 1)
+        )
+        best = _accel_timeit(
+            lambda: wave(params_bf16, pstate, pred0, oh)[0], reps=3
+        )
+        long_rates[name] = slots * horizon / best
+
     out = {
         "metric": "paged_serving_tokens_per_sec",
         "value": round(bf16_rate, 1),
         "int8_value": round(int8_rate, 1),
+        "run_value": round(run_rate, 1),
         "cache_mb": round(bf16_bytes / 2**20, 2),
         "cache_int8_mb": round(int8_bytes / 2**20, 2),
+        "long_context_t3584": {
+            "value": round(long_rates["bf16"], 1),
+            "int8_value": round(long_rates["int8"], 1),
+            "int8_speedup": round(
+                long_rates["int8"] / long_rates["bf16"], 2
+            ),
+            "note": (
+                "decode-only wave scan at 3584-token prefixes, "
+                "512-token pages: page reads (~15 MB/layer/tick bf16) "
+                "dominate the weight stream; int8 pools halve the "
+                "dominant term"
+            ),
+        },
         "note": (
-            "8 x (256-prefix + 128-horizon) via run_waves: batched "
-            "prefill + one on-device scan; ticks read kv pages in place "
-            "(Pallas paged decode kernel)"
+            "8 x (256-prefix + 128-horizon) via run_waves: one compiled "
+            "admit+scan+release program per wave; ticks read kv pages "
+            "in place (Pallas paged decode kernel). Timed with the same "
+            "amortized-readback methodology as the dense rollout "
+            "(device->host reads cost ~65 ms on this tunneled "
+            "accelerator; see BENCH_NOTES.md). run_value = the per-tick "
+            "run() scheduler incl. its end-of-run readback."
         ),
     }
     if dense_tokens_per_sec:
         out["vs_dense_rollout"] = round(bf16_rate / dense_tokens_per_sec, 2)
     return out
+
+
+def bench_serving_multiwave() -> dict:
+    """The workload paging exists for: a request POPULATION (48) much
+    bigger than the slot count (8), ragged lengths (40 short
+    128-prefix/64-horizon + 8 long 896-prefix/128-horizon), a pool (40
+    pages) sized well below population demand (48 requests would need
+    ~120 pages resident) — multi-wave, admission pressure (a full wave
+    of longs needs 64 pages > 40, so the scheduler splits it),
+    retire-and-reuse.
+
+    Three systems on the same workload, same timing methodology:
+
+    - ``paged``: run_waves over a horizon-sorted queue (the scheduler
+      may reorder; sorting packs homogeneous waves) — per-wave padding,
+      pool-bounded memory.
+    - ``dense_grouped``: the strongest dense baseline — requests grouped
+      by exact (prefix, horizon) tier, one ``forecast_deltas`` batch per
+      group. Dense batches REQUIRE homogeneous lengths (the rollout has
+      no ragged masking), which is exactly the flexibility paging buys.
+    - ``dense_per_request``: what dense must do to honor ragged arrival
+      order — one b=1 rollout per request.
+
+    Useful tokens = sum of requested horizons (3584); ride-along /
+    padding waste counts against whichever system incurs it. Memory is
+    reported as resident cache bytes: the paged pool is STATIC (40
+    pages) while dense needs its peak batch transient plus, for a
+    latency-optimal all-resident population, ~3x the pool."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from beholder_tpu.models import (
+        TelemetrySequenceModel,
+        forecast_deltas,
+        init_seq_state,
+    )
+    from beholder_tpu.models.serving import ContinuousBatcher, Request
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    model = TelemetrySequenceModel(dim=512, heads=8, kv_heads=2, layers=4)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 256, model=model)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if x.dtype == jnp.float32 and x.ndim >= 2
+        else x,
+        state.params,
+    )
+    rng = np.random.default_rng(7)
+
+    def mk(prefix, hor):
+        return Request(
+            np.cumsum(1.0 + rng.normal(0, 0.05, prefix + 1)),
+            np.full(prefix + 1, int(TelemetryStatusEntry.CONVERTING)),
+            hor,
+        )
+
+    requests = [mk(128, 64) for _ in range(40)] + [
+        mk(896, 128) for _ in range(8)
+    ]
+    rng.shuffle(requests)  # ragged arrival order
+    useful = sum(r.horizon for r in requests)
+
+    # paged: horizon-sorted queue, pool-bounded waves
+    batcher = ContinuousBatcher(
+        model, params,
+        num_pages=40, page_size=128, slots=8, max_prefix=896,
+        max_pages_per_seq=8,
+    )
+    sorted_reqs = sorted(requests, key=lambda r: -r.horizon)
+    batcher.run_waves(sorted_reqs)  # compile + correctness path
+    t_paged = _accel_timeit(
+        lambda: batcher.run_waves(sorted_reqs, device_results=True)[-1],
+        reps=3,
+    )
+    pool_bytes = sum(
+        leaf.nbytes
+        for pool in batcher.state.k_pools + batcher.state.v_pools
+        for leaf in jax.tree.leaves(pool)
+    )
+
+    # dense baselines
+    roll_cache: dict = {}
+
+    def roll(reqs):
+        t = len(reqs[0].progress) - 1
+        h = max(r.horizon for r in reqs)
+        key = (len(reqs), t, h)
+        if key not in roll_cache:
+            roll_cache[key] = jax.jit(
+                lambda p, pr, st: forecast_deltas(model, p, pr, st, h)
+            )
+        prog = jnp.asarray(np.stack([r.progress for r in reqs]))
+        stats = jnp.asarray(np.stack([r.statuses for r in reqs]))
+        return roll_cache[key](params, prog, stats)
+
+    tiers: dict = {}
+    for r in sorted_reqs:
+        tiers.setdefault((len(r.progress), r.horizon), []).append(r)
+    groups = [
+        grp[i : i + 8]
+        for grp in tiers.values()
+        for i in range(0, len(grp), 8)
+    ]
+
+    def dense_grouped():
+        out = None
+        for grp in groups:
+            out = roll(grp)
+        return out
+
+    dense_grouped()  # compile
+    t_grouped = _accel_timeit(dense_grouped, reps=3)
+
+    def dense_per_request():
+        out = None
+        for r in requests:
+            out = roll([r])
+        return out
+
+    dense_per_request()  # compile
+    t_per_req = _accel_timeit(dense_per_request, reps=2)
+
+    # resident-cache bytes for the dense alternatives (analytic: the
+    # (B, Hkv, max_len, Dh) bf16 k+v per layer that forecast_deltas
+    # allocates)
+    hkv = model.kv_heads or model.heads
+    dh = model.dim // model.heads
+
+    def dense_cache_bytes(b, span):
+        return b * hkv * span * dh * 2 * 2 * model.layers
+
+    dense_peak = max(
+        dense_cache_bytes(len(g), len(g[0].progress) - 1 + g[0].horizon)
+        for g in groups
+    )
+    dense_population = sum(
+        dense_cache_bytes(1, len(r.progress) - 1 + r.horizon)
+        for r in requests
+    )
+
+    return {
+        "metric": "multiwave_serving_tokens_per_sec",
+        "value": round(useful / t_paged, 1),
+        "dense_grouped_value": round(useful / t_grouped, 1),
+        "dense_per_request_value": round(useful / t_per_req, 1),
+        "vs_dense_grouped": round(t_grouped / t_paged, 2),
+        "pool_mb": round(pool_bytes / 2**20, 2),
+        "dense_peak_batch_mb": round(dense_peak / 2**20, 2),
+        "dense_population_mb": round(dense_population / 2**20, 2),
+        "note": (
+            "48 ragged requests (40x 128p/64h + 8x 896p/128h) through 8 "
+            "slots, 40-page pool (admission pressure: a full long wave "
+            "needs 64). Useful tokens / wall time; same amortized-"
+            "readback timing for all three. Memory: the pool is static "
+            "and ~1.6x below dense's peak transient batch, ~3x below an "
+            "all-resident dense population."
+        ),
+    }
 
 
 ACCEL_TIMEOUT_S = 1500  # flash + decode benches, cold-compile worst case
@@ -744,6 +1007,7 @@ def main() -> None:
         accel["ring_block"] = bench_ring_block()
         accel["decode"] = bench_decode()
         accel["serving"] = bench_serving(accel["decode"].get("value"))
+        accel["serving_multiwave"] = bench_serving_multiwave()
         print(json.dumps(accel))
         return
 

@@ -116,3 +116,17 @@ def test_validation_errors():
             q, kp, vp, table, lens,
             k_scale=jnp.ones((32, 2, 16)),
         )
+
+
+def test_dead_slot_sentinel_masks_everything():
+    """lens[s] == -1 marks a released slot: its live page range is empty
+    (no DMAs issued — round-4 advisor finding) and its output row is
+    exactly zero, while live slots are untouched by the dead neighbor."""
+    q, kp, vp, perm, table, lens = _setup(seed=3)
+    dead = jnp.asarray([-1, int(lens[1]), -1, int(lens[3])], jnp.int32)
+    got = np.asarray(paged_decode_attention(q, kp, vp, table, dead))
+    ref = _reference(q, kp, vp, perm, lens)
+    np.testing.assert_array_equal(got[0], 0.0)
+    np.testing.assert_array_equal(got[2], 0.0)
+    np.testing.assert_allclose(got[1], ref[1], rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(got[3], ref[3], rtol=2e-2, atol=2e-2)

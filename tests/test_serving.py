@@ -354,3 +354,78 @@ def test_zero_horizon_request_retires_immediately():
     assert results[0].shape == (0,)
     assert results[1].shape == (4,)
     assert int(batcher.state.free_top) == 8
+
+
+def test_release_many_matches_sequential():
+    """paged_release_many(slots) leaves the same allocator state as
+    releasing each slot in turn: same free_top, same SET of free pages,
+    cleared active/seq_lens."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    st = sv.init_paged(model, num_pages=16, page_size=8, slots=3,
+                       max_pages_per_seq=4)
+    for slot, t in ((0, 13), (1, 9), (2, 20)):
+        f = _feats(_request(slot, t=t, horizon=0))
+        _, st = sv.paged_admit(
+            model, state0.params, st, jnp.int32(slot),
+            jnp.pad(f, ((0, 0), (0, 32 - f.shape[1]), (0, 0))),
+            jnp.int32(t),
+        )
+    many = sv.paged_release_many(st, jnp.asarray([0, 2], jnp.int32))
+    seq = sv.paged_release(sv.paged_release(st, jnp.int32(0)), jnp.int32(2))
+    assert int(many.free_top) == int(seq.free_top)
+    n = int(many.free_top)
+    assert set(np.asarray(many.free_stack[:n]).tolist()) == set(
+        np.asarray(seq.free_stack[:n]).tolist()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(many.active), np.asarray(seq.active)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(many.seq_lens), np.asarray(seq.seq_lens)
+    )
+
+
+def test_run_waves_device_results_mode():
+    """device_results=True returns device arrays (no host readback)
+    equal to the fetching mode's results."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    requests = [_request(i, t=10 + 3 * i, horizon=4 + i) for i in range(3)]
+
+    def mk():
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=24, page_size=8, slots=2, max_prefix=32,
+            max_pages_per_seq=8,
+        )
+
+    want = mk().run_waves(requests)
+    got = mk().run_waves(requests, device_results=True)
+    for i in range(len(requests)):
+        assert isinstance(got[i], jax.Array)
+        np.testing.assert_allclose(
+            np.asarray(got[i]), want[i], rtol=1e-6, atol=1e-7
+        )
+
+
+def test_unservable_request_fails_fast_without_poisoning():
+    """An unservable request anywhere in the queue raises BEFORE any
+    admission (no pages held), and the batcher stays usable; a genuine
+    mid-run failure would instead poison it (RuntimeError on reuse)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(3), 16, model=model)
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=8, page_size=8, slots=2, max_prefix=16,
+        max_pages_per_seq=4,
+    )
+    good = _request(0, t=10, horizon=3)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        batcher.run([good, _request(7, t=14, horizon=40)])
+    assert int(batcher.state.free_top) == 8  # nothing was admitted
+    with pytest.raises(ValueError, match="max_prefix"):
+        batcher.run_waves([good, _request(1, t=30, horizon=2)])
+    # still healthy: the valid request alone serves fine
+    (result,) = batcher.run([good])
+    assert result.shape == (3,)
