@@ -4,6 +4,11 @@
 
 PROTOC ?= protoc
 CXX ?= g++
+PYTHON ?= python3
+# ABI-tagged extension name (e.g. framecodec_ext.cpython-312-x86_64-…so)
+# so a build from one interpreter can never be imported by another; the
+# loader also accepts the plain name for pre-existing builds.
+EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
 .PHONY: all proto native test bench lint clean
 
@@ -13,7 +18,7 @@ proto:
 	$(PROTOC) --python_out=beholder_tpu/proto -I beholder_tpu/proto \
 		beholder_tpu/proto/api.proto
 
-native: native/build/libframecodec.so native/build/framecodec_ext.so
+native: native/build/libframecodec.so native/build/framecodec_ext$(EXT_SUFFIX)
 
 native/build/libframecodec.so: native/framecodec.cc
 	mkdir -p native/build
@@ -21,10 +26,10 @@ native/build/libframecodec.so: native/framecodec.cc
 
 # CPython C-API binding (zero ctypes marshaling overhead; see
 # native/framecodec_pymod.cc). Python.h location comes from sysconfig.
-native/build/framecodec_ext.so: native/framecodec_pymod.cc
+native/build/framecodec_ext$(EXT_SUFFIX): native/framecodec_pymod.cc
 	mkdir -p native/build
 	$(CXX) -O2 -Wall -Wextra -shared -fPIC \
-		-I$$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])") \
+		-I$$($(PYTHON) -c "import sysconfig; print(sysconfig.get_paths()['include'])") \
 		-o $@ $<
 
 test:

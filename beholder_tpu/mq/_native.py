@@ -63,11 +63,20 @@ def _load_ext():
     catch-up bursts; measured round 4)."""
     import importlib.util
 
+    import sysconfig
+
     override = os.environ.get("BEHOLDER_FRAMECODEC_EXT")
+    # the ABI-tagged name is what `make native` builds (a .so from one
+    # interpreter version must never be imported by another); the plain
+    # name is accepted for pre-existing builds
+    names = (
+        "framecodec_ext" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"),
+        "framecodec_ext.so",
+    )
     candidates = (
         [Path(override)]
         if override
-        else [d / "framecodec_ext.so" for d in _SEARCH_DIRS]
+        else [d / n for d in _SEARCH_DIRS for n in names]
     )
     for path in candidates:
         if path.is_file():

@@ -389,17 +389,37 @@ class FrameParser:
             try:
                 frames, consumed = self._ext(self._buf, Frame)
             except ValueError as err:
-                raise ProtocolError(str(err)) from None
+                self._raise_bad_frame(err)
             del self._buf[:consumed]
             return frames
         if self._scanner is not None:
             try:
                 frames, consumed = self._scanner.scan(self._buf, Frame)
             except ValueError as err:
-                raise ProtocolError(str(err)) from None
+                self._raise_bad_frame(err)
             del self._buf[:consumed]
             return frames
         return self._feed_python()
+
+    def _raise_bad_frame(self, err: ValueError):
+        """Normalize post-error buffer state across backends: the native
+        scanners raise WITHOUT consuming the good frames before the bad
+        one (they stay in the buffer, so a retry would re-raise at the
+        same point), while the pure-Python walk consumes as it goes.
+        Both native layers report the bad frame's start offset in their
+        documented message format — trim up to it so all three backends
+        leave the buffer starting AT the bad frame, exactly like the
+        Python walk (round-4 advisor finding)."""
+        import re
+
+        msg = str(err)
+        m = re.search(r"offset (\d+)$", msg)
+        if m:
+            del self._buf[: int(m.group(1))]
+            # the reported offset described the PRE-trim buffer; the
+            # retained buffer now starts at the bad frame
+            msg += " (buffer trimmed; the bad frame is now at offset 0)"
+        raise ProtocolError(msg) from None
 
     def _feed_python(self) -> list[Frame]:
         frames = []

@@ -182,12 +182,20 @@ def ring_attention(
 
     ``backend="flash"`` (default) runs each rotation's local block
     attend INSIDE the Pallas flash kernels — the masks take the rotated
-    block's global row offsets, so the distributed long-context path
-    runs at kernel rate, not XLA-einsum rate (the round-3 gap). The
-    forward combines each pair's (o, logsumexp) with the online-softmax
-    recurrence; the backward recomputes each pair's probabilities from
-    the saved GLOBAL logsumexp inside the flash backward kernels.
-    ``backend="einsum"`` keeps the transparent XLA reference path.
+    block's global row offsets. Measured honestly (BENCH r05
+    ``ring_block``, slope-timed on v5e at T/P=2048): the kernel is at
+    PARITY with the XLA einsum block-attend on BOTH the fully-live
+    mid-ring rotation and the half-masked diagonal one (~0.96x each) —
+    round 3's premise that the distributed path was "running at einsum
+    rate, not kernel rate" did not survive tunnel-robust timing. The
+    kernel stays the default for MEMORY, not speed: it runs in O(block)
+    VMEM while the einsum materializes the (T/P, T/P) f32 score block
+    per head group (134 MB at T/P=2048, growing quadratically with the
+    shard). The forward combines each pair's (o, logsumexp) with the
+    online-softmax recurrence; the backward recomputes each pair's
+    probabilities from the saved GLOBAL logsumexp inside the flash
+    backward kernels. ``backend="einsum"`` keeps the transparent XLA
+    reference path.
     """
     p_size = mesh.shape[axis]
     t = q.shape[-2]

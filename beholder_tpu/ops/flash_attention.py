@@ -83,10 +83,13 @@ def _pick_block(t_pad: int, window: int | None = None) -> int:
     window every live block sits on the band edge and pays the full
     (block, block) mask compute; with block ~ window each q row touches
     ~2 small blocks and the mask shrinks quadratically, trading into
-    fixed per-step grid overhead instead. Measured on v5e at T=16k the
-    two effects balance (~1.4x over full causal either way); the cap
-    keeps the live-step count — and VMEM footprint — proportional to
-    the window rather than to T."""
+    fixed per-step grid overhead instead. Round-5 slope-timed
+    measurement (10 alternating rounds, min estimator — see
+    BENCH_NOTES.md on why block-until-ready timing lied here): block =
+    window and block = window/2 are within 3% at w=1024@T=16k, so the
+    cap keeps the simple rule; its real job is keeping the live-step
+    count — and VMEM footprint — proportional to the window rather
+    than to T."""
     b = _MAX_BLOCK
     if window is not None:
         cap = max(_MIN_BLOCK, 1 << (window - 1).bit_length())
@@ -202,36 +205,29 @@ def _band_tables_kv_major(n_blk, block, window):
 # more VPU work than the overlap recovers (27.1 vs 17-22 TFLOP/s).
 _SUB = 1024
 
-
-def _n_bias_tiles(causal, window, block, t_pad, t_real, has_seg, has_off):
-    """Number of precomputed additive mask-bias tiles the forward kernel
-    keeps in VMEM scratch, or 0 when the inline iota mask must run.
-
-    The causal/window mask of a (qi, kj) block pair depends ONLY on the
-    block-offset o = qi - kj, so the masked steps of the packed grid can
-    reuse o's precomputed (block, block) bias tile: one f32 add per step
-    instead of ~6 iota/compare/select VPU passes — which round-3
-    profiling showed DOMINATING the banded grid (the band's matmuls are
-    ~6us/step while the inline mask costs ~11us at block=1024, capping
-    the w=1024@T=16k speedup at 1.73x of the ~4.4x step-count saving).
-    Runtime-dependent masks (segments, ring offsets) and padded T (the
-    last kv block's column cutoff varies by step pair) keep the inline
-    path."""
-    if has_seg or has_off or not causal or t_pad != t_real:
-        return 0
-    if window is None:
-        n = 1  # only the diagonal masks
-    else:
-        n = 2 + (window - 2) // block  # offsets 0..reach
-    if n * block * block * 4 > 6 * 2**20:  # VMEM budget guard
-        return 0
-    return n
+# NEGATIVE RESULT (round 5): rounds 3-4 carried a "precomputed mask-bias
+# tile" path here — per-block-offset (block, block) f32 tiles in VMEM
+# scratch, added to masked steps' scores instead of running the inline
+# iota/compare/select mask — on the theory that the inline mask's VPU
+# passes dominated the banded grid (the recorded w=1024@T=16k speedup
+# was stuck at 1.73x of an ~8x FLOP saving). Round-5 re-measurement with
+# tunnel-robust slope timing (see BENCH_NOTES.md "the serving 100x was
+# the tunnel") showed the premise was a measurement artifact: the old
+# timing charged a ~65 ms device->host readback constant across 20 reps
+# (~3.2 ms) onto a ~1.4 ms kernel. Measured honestly and interleaved on
+# v5e, the INLINE mask wins or ties at every shape tried — w=1024@T=16k
+# tiles-at-block-512 2.6x SLOWER, tiles-at-block-1024 (raised budget)
+# ~1.2x slower, T=4096 causal diagonal tile ~1.2x slower, T=16k causal
+# a wash — and the window speedup with the plain inline mask is ~4x
+# (ROOFLINE.md). The tile machinery was deleted rather than kept behind
+# a flag: it costs VMEM, a guard, and a silent-veto failure mode
+# (round-4 advisor finding) for a path that never pays.
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
     m_ref, l_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block,
-    window, qoff=None, kvoff=None, bias_ref=None,
+    window, qoff=None, kvoff=None,
 ):
     """One (block, d) q tile x one streamed (block, d) kv tile.
 
@@ -266,9 +262,6 @@ def _fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    if bias_ref is not None:
-        _init_bias_tiles(bias_ref, block, window)
-
     sub = min(_SUB, block)
     n_sub = block // sub
 
@@ -283,11 +276,7 @@ def _fwd_kernel(
                 q, kc, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )                              # (bq, sub) f32
-            if masked and bias_ref is not None:
-                # packed-grid band/diagonal mask: one precomputed
-                # additive tile per block offset (see _n_bias_tiles)
-                s = s + bias_ref[qi - kj][:, j2 * sub:(j2 + 1) * sub]
-            elif masked:
+            if masked:
                 rows = qi * block + jax.lax.broadcasted_iota(
                     jnp.int32, (block, sub), 0
                 )
@@ -489,30 +478,18 @@ def _flash_fwd_padded(
             lambda b, l, *tabs: (b // seg_div, 0, tabs[1][l]),
         )
 
-        n_bias = _n_bias_tiles(
-            causal, window, block, t_pad, t_real, has_seg, False
-        )
-        bias_scratch = (
-            [pltpu.VMEM((n_bias, block, block), jnp.float32)]
-            if n_bias
-            else []
-        )
-
         def kernel(qt_ref, kt_ref, ft_ref, lt_ref, q_ref, k_ref, v_ref,
                    *rest):
             qseg_ref, kseg_ref = (rest[0], rest[1]) if has_seg else (None, None)
             rest = rest[2 if has_seg else 0:]
-            bias_ref = rest[-1] if n_bias else None
-            o_ref, lse_ref, m_ref, l_ref, acc_ref = (
-                rest[:-1] if n_bias else rest
-            )
+            o_ref, lse_ref, m_ref, l_ref, acc_ref = rest
             lin = pl.program_id(1)
             _fwd_kernel(
                 q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
                 m_ref, l_ref, acc_ref,
                 (qt_ref[lin], kt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block, window=window, bias_ref=bias_ref,
+                block=block, window=window,
             )
 
         o, lse = pl.pallas_call(
@@ -530,7 +507,7 @@ def _flash_fwd_padded(
                     pl.BlockSpec((1, block, d_pad), q_map),
                     pl.BlockSpec((1, block, _LANES), q_map),
                 ],
-                scratch_shapes=scratch + bias_scratch,
+                scratch_shapes=scratch,
             ),
             out_shape=out_shape,
             interpret=interpret,
@@ -578,25 +555,10 @@ def _flash_fwd_padded(
 # ---------------------------------------------------------------------------
 
 
-def _init_bias_tiles(bias_ref, block, window):
-    """Fill the per-offset mask-bias tiles (see _n_bias_tiles) on the
-    kernel's first grid step (grid iteration is sequential per core)."""
-    @pl.when((pl.program_id(0) == 0) & (pl.program_id(1) == 0))
-    def _():
-        d = jax.lax.broadcasted_iota(
-            jnp.int32, (block, block), 0
-        ) - jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
-        for off in range(bias_ref.shape[0]):
-            live = d + off * block >= 0  # causal on global rows/cols
-            if window is not None:
-                live = live & (d + off * block < window)
-            bias_ref[off] = jnp.where(live, 0.0, _NEG_INF)
-
-
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
     dq_ref, acc_ref, band, *, t_real, t_pad, causal, scale, block, window,
-    qoff=None, kvoff=None, bias_ref=None,
+    qoff=None, kvoff=None,
 ):
     n_blk = t_pad // block
     has_seg = qseg_ref is not None
@@ -607,9 +569,6 @@ def _dq_kernel(
         kj = pl.program_id(2)
         is_first = kj == 0
         is_last = kj == pl.num_programs(2) - 1
-
-    if bias_ref is not None:
-        _init_bias_tiles(bias_ref, block, window)
 
     @pl.when(is_first)
     def _init():
@@ -622,9 +581,7 @@ def _dq_kernel(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if masked and bias_ref is not None:
-            s = s + bias_ref[qi - kj]  # precomputed per-offset tile
-        elif masked:
+        if masked:
             rows = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0
             )
@@ -678,7 +635,7 @@ def _dq_kernel(
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref, kseg_ref,
     dk_ref, dv_ref, dk_acc, dv_acc, band, *, t_real, t_pad, causal, scale,
-    block, window, qoff=None, kvoff=None, bias_ref=None,
+    block, window, qoff=None, kvoff=None,
 ):
     n_blk = t_pad // block
     has_seg = qseg_ref is not None
@@ -689,9 +646,6 @@ def _dkv_kernel(
         qi = pl.program_id(2)
         is_first = qi == 0
         is_last = qi == pl.num_programs(2) - 1
-
-    if bias_ref is not None:
-        _init_bias_tiles(bias_ref, block, window)
 
     @pl.when(is_first)
     def _init():
@@ -706,9 +660,7 @@ def _dkv_kernel(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        if masked and bias_ref is not None:
-            s = s + bias_ref[qi - kj]  # precomputed per-offset tile
-        elif masked:
+        if masked:
             rows = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0
             )
@@ -880,26 +832,13 @@ def _flash_bwd_padded(
         q_map = lambda b, l, *t: (b, t[0][l], 0)
         kv_map = lambda b, l, *t: (b // group, t[1][l], 0)
 
-        n_bias = _n_bias_tiles(
-            causal, window, block, t_pad, t_real, has_seg, False
-        )
-        bias_scratch = (
-            [pltpu.VMEM((n_bias, block, block), jnp.float32)]
-            if n_bias
-            else []
-        )
-
         def dq_kernel(at_ref, bt_ref, ft_ref, lt_ref, *refs):
-            if n_bias:
-                bias_ref, refs = refs[-1], refs[:-1]
-            else:
-                bias_ref = None
             lin = pl.program_id(1)
             _dq_kernel(
                 *unpack(refs),
                 (at_ref[lin], bt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block, window=window, bias_ref=bias_ref,
+                block=block, window=window,
             )
 
         dq = pl.pallas_call(
@@ -916,7 +855,7 @@ def _flash_bwd_padded(
                     ),
                 ],
                 out_specs=tile(q_map),
-                scratch_shapes=dq_scratch + bias_scratch,
+                scratch_shapes=dq_scratch,
             ),
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
@@ -934,16 +873,12 @@ def _flash_bwd_padded(
         q_map2 = lambda b, l, *t: (b, t[1][l], 0)
 
         def dkv_kernel(kt_ref, qt_ref, ft_ref, lt_ref, *refs):
-            if n_bias:
-                bias_ref, refs = refs[-1], refs[:-1]
-            else:
-                bias_ref = None
             lin = pl.program_id(1)
             _dkv_kernel(
                 *unpack(refs),
                 (kt_ref[lin], qt_ref[lin], ft_ref[lin] == 1, lt_ref[lin] == 1),
                 t_real=t_real, t_pad=t_pad, causal=causal, scale=scale,
-                block=block, window=window, bias_ref=bias_ref,
+                block=block, window=window,
             )
 
         dk, dv = pl.pallas_call(
@@ -960,7 +895,7 @@ def _flash_bwd_padded(
                     ),
                 ],
                 out_specs=[tile(dkv_map2), tile(dkv_map2)],
-                scratch_shapes=dkv_scratch + bias_scratch,
+                scratch_shapes=dkv_scratch,
             ),
             out_shape=dkv_out_shape,
             interpret=interpret,

@@ -70,3 +70,51 @@ def test_native_handles_more_frames_than_batch_limit():
     stream = frame * 5000
     got = codec.FrameParser(use_native=True).feed(stream)
     assert len(got) == 5000
+
+
+def test_post_error_buffer_state_matches_python():
+    """All backends must leave IDENTICAL buffer state after a bad
+    frame-end: good frames consumed, buffer starting at the bad frame
+    (round-4 advisor finding: the native paths used to leave the good
+    frames in the buffer, so a retry re-raised at the same point)."""
+    good = codec.heartbeat_frame()
+    stream = bytearray(good.serialize() * 2)
+    bad = bytearray(good.serialize())
+    bad[-1] = 0x00
+    stream += bad
+
+    def run(**kw):
+        p = codec.FrameParser(**kw)
+        with pytest.raises(codec.ProtocolError):
+            p.feed(bytes(stream))
+        return bytes(p._buf)
+
+    want = run(use_native=False)
+    assert want == bytes(bad)  # python walk: bad frame at buffer start
+    got_native = run(use_native=True)
+    assert got_native == want
+
+    # the ctypes scanner path specifically (ext disabled); save/restore
+    # any pre-existing override of the documented env var
+    import os
+
+    saved = os.environ.get("BEHOLDER_FRAMECODEC_EXT")
+    os.environ["BEHOLDER_FRAMECODEC_EXT"] = "/nonexistent"
+    try:
+        from importlib import reload
+
+        from beholder_tpu.mq import _native as nat
+
+        reload(nat)
+        if nat.available():
+            p = codec.FrameParser(use_native=False)
+            p._bind_native(nat)
+            with pytest.raises(codec.ProtocolError):
+                p.feed(bytes(stream))
+            assert bytes(p._buf) == want
+    finally:
+        if saved is None:
+            os.environ.pop("BEHOLDER_FRAMECODEC_EXT", None)
+        else:
+            os.environ["BEHOLDER_FRAMECODEC_EXT"] = saved
+        reload(nat)
