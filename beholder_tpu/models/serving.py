@@ -512,6 +512,34 @@ def _tick_with_carry(model, params, state, carry: _RunCarry, write_idx):
     )
 
 
+def _tick_chunk(model, params, state, carry: _RunCarry, write_idx, n):
+    """``n`` decode ticks in ONE program. Between two scheduling events
+    (admission, retirement) the per-tick scheduler has no decisions to
+    make, so it runs the whole event-free stretch on device —
+    ``lax.while_loop`` because ``n`` is traced (one compile serves every
+    chunk length; a scan's length would be a static recompile key).
+    ``write_idx`` gives each active slot's forecast column for the FIRST
+    tick; tick i writes column ``write_idx + i`` (the cap sentinel stays
+    OOB for the whole chunk since the buffer is only ``cap`` wide and
+    drops handle the rest)."""
+    cap = carry.delta_buf.shape[1]
+
+    def cond(c):
+        i, _, _ = c
+        return i < n
+
+    def body(c):
+        i, state, carry = c
+        cur = jnp.where(write_idx >= cap, cap, write_idx + i)
+        state, carry = _tick_with_carry(model, params, state, carry, cur)
+        return i + 1, state, carry
+
+    _, state, carry = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), state, carry)
+    )
+    return state, carry
+
+
 class Request(NamedTuple):
     progress: np.ndarray   # (T+1,) observed progress
     statuses: np.ndarray   # (T+1,) observed statuses
@@ -569,6 +597,9 @@ class ContinuousBatcher:
         )
         self._tick_carry = jax.jit(
             lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
+        )
+        self._tick_chunk = jax.jit(
+            lambda p, s, c, w, n: _tick_chunk(model, p, s, c, w, n)
         )
         # serve_wave programs jit per (n, n_ticks, horizons) — the scan
         # length and in-program trims are static
@@ -648,16 +679,20 @@ class ContinuousBatcher:
     # -- flexible path: per-tick scheduling -----------------------------
 
     def run(self, requests: list[Request]) -> list[np.ndarray]:
-        """Per-tick scheduling with on-device feedback: each tick is ONE
-        fused dispatch (:func:`_tick_with_carry`); retirement snapshots
-        a slot's forecast row as a device array (async slice, no sync);
-        everything is read back in one ``jax.device_get`` at the end.
+        """Per-EVENT scheduling with on-device feedback: the scheduler
+        only touches the host at scheduling events (admissions and
+        retirements); the event-free stretches between them — every
+        tick until the earliest retirement — run as one device program
+        (:func:`_tick_chunk`). Retirement snapshots a slot's forecast
+        row as a device array (async slice, no sync); everything is
+        read back in one ``jax.device_get`` at the end.
 
-        This is the latency/flexibility path — requests admit the tick a
-        slot frees up, so mixed-horizon fleets keep all slots busy. Its
-        per-tick host dispatch (~0.1-0.5 ms) caps throughput below
-        :meth:`run_waves`' fused scan; both are measured side by side in
-        ``bench.py`` (``serving.run_value`` vs ``serving.value``)."""
+        This is the flexibility path — requests admit the moment a slot
+        frees up, so mixed-horizon fleets keep all slots busy — and
+        since round 5's event-chunking its throughput approaches
+        :meth:`run_waves` (which still wins by fusing admission and
+        release into the same program). Both are measured side by side
+        in ``bench.py`` (``serving.run_value`` vs ``serving.value``)."""
         self._start_run(requests)
         try:
             return self._run(requests)
@@ -748,19 +783,26 @@ class ContinuousBatcher:
             if not any(r is not None for r in req_of):
                 continue
 
-            # one fused tick for every slot (inactive slots ride along;
-            # their forecast write drops at the cap sentinel)
-            write_idx = np.where(
-                [r is not None for r in req_of], written, cap
-            ).astype(np.int32)
-            self.state, carry = self._tick_carry(
-                self.params, self.state, carry, jnp.asarray(write_idx)
+            # run every tick until the NEXT scheduling event (the
+            # earliest retirement) as ONE device program: between events
+            # the scheduler has no decisions to make, so per-tick
+            # dispatch would be pure overhead (inactive slots ride
+            # along; their forecast writes drop at the cap sentinel)
+            active = [r is not None for r in req_of]
+            n_chunk = max(
+                1, int(min(remaining[s] for s in range(self.slots)
+                           if active[s])) - 1
+            )
+            write_idx = np.where(active, written, cap).astype(np.int32)
+            self.state, carry = self._tick_chunk(
+                self.params, self.state, carry, jnp.asarray(write_idx),
+                jnp.int32(n_chunk),
             )
             for slot in range(self.slots):
                 if req_of[slot] is None:
                     continue
-                written[slot] += 1
-                remaining[slot] -= 1
+                written[slot] += n_chunk
+                remaining[slot] -= n_chunk
                 if remaining[slot] <= 1:
                     retire(slot)
 

@@ -429,3 +429,43 @@ def test_unservable_request_fails_fast_without_poisoning():
     # still healthy: the valid request alone serves fine
     (result,) = batcher.run([good])
     assert result.shape == (3,)
+
+
+def test_tick_chunk_equals_per_tick_loop():
+    """_tick_chunk(n) must replay exactly n _tick_with_carry steps:
+    same state, same forecast buffer, same last predictions."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    st = sv.init_paged(model, num_pages=16, page_size=8, slots=2,
+                       max_pages_per_seq=8)
+    carry = sv._RunCarry(
+        jnp.zeros((2,)), jnp.zeros((2, NUM_STATUSES)), jnp.zeros((2, 6))
+    )
+    for slot, t in ((0, 13), (1, 9)):
+        f = _feats(_request(slot, t=t, horizon=0))
+        st, carry = sv._admit_with_carry(
+            model, state0.params, st, carry, jnp.int32(slot),
+            jnp.pad(f, ((0, 0), (0, 16 - f.shape[1]), (0, 0))),
+            jnp.int32(t), jnp.int32(2),
+        )
+
+    w0 = jnp.asarray([0, 0], jnp.int32)
+    st_c, carry_c = sv._tick_chunk(
+        model, state0.params, st, carry, w0, jnp.int32(4)
+    )
+    st_l, carry_l = st, carry
+    for i in range(4):
+        st_l, carry_l = sv._tick_with_carry(
+            model, state0.params, st_l, carry_l, w0 + i
+        )
+    np.testing.assert_allclose(
+        np.asarray(carry_c.delta_buf), np.asarray(carry_l.delta_buf),
+        rtol=1e-6, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(carry_c.last_pred), np.asarray(carry_l.last_pred),
+        rtol=1e-6, atol=1e-7,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_c.seq_lens), np.asarray(st_l.seq_lens)
+    )
