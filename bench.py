@@ -1044,6 +1044,17 @@ def _run_accel_benches() -> dict:
     import sys
 
     timeout = int(os.environ.get("BENCH_ACCEL_TIMEOUT", str(ACCEL_TIMEOUT_S)))
+
+    def last_json(text: str) -> dict | None:
+        for line in reversed((text or "").strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict):  # a stray scalar line must not win
+                return obj
+        return None
+
     try:
         proc = subprocess.run(
             [sys.executable, __file__, "--accel-only"],
@@ -1051,18 +1062,29 @@ def _run_accel_benches() -> dict:
             text=True,
             timeout=timeout,
         )
-    except subprocess.TimeoutExpired:
-        return {"error": f"accelerator benches timed out after {timeout}s"}
+    except subprocess.TimeoutExpired as err:
+        # the subprocess prints a cumulative JSON line after each
+        # completed section — salvage the sections that finished
+        partial = last_json(
+            err.stdout.decode() if isinstance(err.stdout, bytes)
+            else err.stdout
+        )
+        msg = f"accelerator benches timed out after {timeout}s"
+        if partial is not None:
+            partial["error"] = msg + " (partial: later sections missing)"
+            return partial
+        return {"error": msg}
     if proc.returncode != 0:
+        partial = last_json(proc.stdout)
         tail = (proc.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
-        return {"error": f"accelerator benches failed: {tail[0]}"}
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            obj = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(obj, dict):  # a stray scalar line must not win
-            return obj
+        msg = f"accelerator benches failed: {tail[0]}"
+        if partial is not None:
+            partial["error"] = msg + " (partial: later sections missing)"
+            return partial
+        return {"error": msg}
+    obj = last_json(proc.stdout)
+    if obj is not None:
+        return obj
     return {"error": "accelerator benches produced no JSON"}
 
 
@@ -1084,11 +1106,20 @@ def main() -> None:
             )
         except Exception:
             pass
+        # one JSON line per completed section (cumulative): if the
+        # tunnel dies mid-run and the parent's timeout kills this
+        # subprocess, the parent salvages the LAST parseable line, so a
+        # partial outage degrades to partial figures instead of none
         accel = bench_aggregation()
+        print(json.dumps(accel), flush=True)
         accel["flash"] = bench_flash_attention()
+        print(json.dumps(accel), flush=True)
         accel["ring_block"] = bench_ring_block()
+        print(json.dumps(accel), flush=True)
         accel["decode"] = bench_decode()
+        print(json.dumps(accel), flush=True)
         accel["serving"] = bench_serving(accel["decode"].get("value"))
+        print(json.dumps(accel), flush=True)
         accel["serving_multiwave"] = bench_serving_multiwave()
         print(json.dumps(accel))
         return
