@@ -30,10 +30,12 @@ from .serving import (
     ContinuousBatcher,
     PagedKVState,
     Request,
+    fork_wave,
     init_paged,
     paged_admit,
     paged_admit_batch,
     paged_decode_tick,
+    paged_fork,
     paged_release,
     paged_wave,
 )
@@ -42,10 +44,12 @@ __all__ = [
     "ContinuousBatcher",
     "PagedKVState",
     "Request",
+    "fork_wave",
     "init_paged",
     "paged_admit",
     "paged_admit_batch",
     "paged_decode_tick",
+    "paged_fork",
     "paged_release",
     "paged_wave",
     "decode_step",

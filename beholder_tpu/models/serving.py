@@ -30,6 +30,16 @@ a CHANGING population of requests the way modern LLM servers do
   measured per round in BENCH_NOTES.md (~1.2x at the headline shape,
   ~0.8x at long context where the kernel is issue-bound, not
   bandwidth-bound) — int8's contract here is capacity, not speed.
+- **Prefix sharing** (:func:`paged_fork` / :meth:`ContinuousBatcher.
+  run_what_if`): one sequence forked into k branches shares its FULL
+  prefix pages by refcount (``page_ref``) — a slot only writes at its
+  own length, past every full prefix page, so shared pages are
+  read-only without any copy-on-write machinery; only a partial tail
+  page is copied per fork. Prefill runs once instead of k times and
+  the pool holds the prefix once — the vLLM parallel-sampling lever,
+  used here for what-if forecasting (same telemetry history, k
+  hypothetical status branches). Release returns a page to the free
+  stack only when its last owner retires.
 - **Continuous batching, two ways.** :meth:`ContinuousBatcher.run` is
   the flexible scheduler: admit queued requests into free slots
   mid-flight, tick all active slots together, retire finished ones. For
@@ -77,6 +87,10 @@ class PagedKVState(NamedTuple):
     - ``active``: (slots,) bool
     - ``free_stack``: (num_pages,) pool indices; ``free_stack[:free_top]``
       are free
+    - ``page_ref``: (num_pages,) reference counts — 1 for a page owned by
+      one slot, >1 for a prefix page SHARED between forks
+      (:func:`paged_fork`); release only returns a page to the free stack
+      when its count reaches zero
     - ``alloc_failed``: sticky error flag (pool exhausted / table
       overflow) — checked host-side by the batcher
     """
@@ -88,6 +102,7 @@ class PagedKVState(NamedTuple):
     active: jax.Array
     free_stack: jax.Array
     free_top: jax.Array
+    page_ref: jax.Array
     alloc_failed: jax.Array
 
 
@@ -119,6 +134,7 @@ def init_paged(
         jnp.zeros((slots,), bool),
         jnp.arange(num_pages, dtype=jnp.int32),
         jnp.int32(num_pages),
+        jnp.zeros((num_pages,), jnp.int32),
         jnp.zeros((), bool),
     )
 
@@ -133,13 +149,41 @@ def _pool_geometry(state: PagedKVState) -> tuple[int, int]:
 def _pop_pages(state: PagedKVState, need: jax.Array):
     """Vectorized masked stack pop: needer i (with ``need[i]``) gets page
     ``free_stack[free_top - 1 - rank_i]`` where rank_i numbers the
-    needers. Returns (pages (len(need),), new_top, failed)."""
+    needers; popped pages start at refcount 1. Returns
+    (pages (len(need),), new_top, new_ref, failed)."""
+    num_pages = state.free_stack.shape[0]
     rank = jnp.cumsum(need.astype(jnp.int32)) - 1
     n = need.sum().astype(jnp.int32)
     idx = state.free_top - 1 - rank
     failed = state.alloc_failed | (n > state.free_top)
-    pages = state.free_stack[jnp.clip(idx, 0, state.free_stack.shape[0] - 1)]
-    return pages, state.free_top - n, failed
+    pages = state.free_stack[jnp.clip(idx, 0, num_pages - 1)]
+    ref = state.page_ref.at[
+        jnp.where(need, pages, num_pages)
+    ].set(1, mode="drop")
+    return pages, state.free_top - n, ref, failed
+
+
+def _unref_pages(
+    state: PagedKVState, held_flat: jax.Array, alive_flat: jax.Array
+) -> PagedKVState:
+    """Drop one reference from each held page (``held_flat`` page ids
+    where ``alive_flat``); pages whose count reaches zero go back on the
+    free stack in ONE vectorized pass over the pool (a compaction scan —
+    no dedup needed even when several released slots shared a page)."""
+    num_pages, _ = _pool_geometry(state)
+    ids = jnp.where(alive_flat, held_flat, num_pages)
+    ref = state.page_ref.at[ids].add(-1, mode="drop")
+    newly_free = (ref <= 0) & (state.page_ref > 0)
+    rank = jnp.cumsum(newly_free.astype(jnp.int32)) - 1
+    dest = jnp.where(newly_free, state.free_top + rank, num_pages)
+    stack = state.free_stack.at[dest].set(
+        jnp.arange(num_pages, dtype=jnp.int32), mode="drop"
+    )
+    return state._replace(
+        free_stack=stack,
+        free_top=state.free_top + newly_free.sum().astype(jnp.int32),
+        page_ref=jnp.maximum(ref, 0),
+    )
 
 
 def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
@@ -148,7 +192,7 @@ def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
     _, page = _pool_geometry(state)
     slots, max_pages = state.page_table.shape
     need = state.active & (state.seq_lens % page == 0)
-    pages, new_top, failed = _pop_pages(state, need)
+    pages, new_top, ref, failed = _pop_pages(state, need)
     pidx = state.seq_lens // page
     failed = failed | jnp.any(need & (pidx >= max_pages))
     rows = jnp.where(need, jnp.arange(slots), slots)  # OOB row -> dropped
@@ -156,7 +200,8 @@ def _alloc_for_tick(state: PagedKVState) -> PagedKVState:
         rows, jnp.clip(pidx, 0, max_pages - 1)
     ].set(pages, mode="drop")
     return state._replace(
-        page_table=table, free_top=new_top, alloc_failed=failed
+        page_table=table, free_top=new_top, page_ref=ref,
+        alloc_failed=failed,
     )
 
 
@@ -297,7 +342,7 @@ def paged_admit_batch(
         jax.lax.broadcasted_iota(jnp.int32, (n, p_max), 1)
         < n_pages[:, None]
     )
-    pages, new_top, failed = _pop_pages(state, chunk_alive.reshape(-1))
+    pages, new_top, ref, failed = _pop_pages(state, chunk_alive.reshape(-1))
     pages = pages.reshape(n, p_max)
     failed = failed | jnp.any(n_pages > max_pages)
 
@@ -339,26 +384,21 @@ def paged_admit_batch(
         ),
         active=state.active.at[safe_slots].set(admitted, mode="drop"),
         free_top=new_top,
+        page_ref=ref,
         alloc_failed=failed,
     )
     return last_pred, state
 
 
 def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
-    """Retire ``slot``: push its pages back onto the free stack."""
-    num_pages, page = _pool_geometry(state)
+    """Retire ``slot``: drop one reference from each of its pages;
+    pages nobody else shares go back on the free stack."""
+    _, page = _pool_geometry(state)
     max_pages = state.page_table.shape[1]
     n = -(-state.seq_lens[slot] // page)
     alive = jnp.arange(max_pages) < n
-    dest = jnp.where(
-        alive, state.free_top + jnp.arange(max_pages), num_pages
-    )
-    stack = state.free_stack.at[dest].set(
-        state.page_table[slot], mode="drop"
-    )
+    state = _unref_pages(state, state.page_table[slot], alive)
     return state._replace(
-        free_stack=stack,
-        free_top=state.free_top + n,
         active=state.active.at[slot].set(False),
         seq_lens=state.seq_lens.at[slot].set(0),
     )
@@ -367,10 +407,12 @@ def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
 def paged_release_many(
     state: PagedKVState, slot_ids: jax.Array
 ) -> PagedKVState:
-    """Retire several (distinct) slots in one vectorized stack push —
-    the in-jit tail of :func:`serve_wave`. Inactive slots in
-    ``slot_ids`` contribute zero pages (their ``seq_lens`` is 0)."""
-    num_pages, page = _pool_geometry(state)
+    """Retire several (distinct) slots in one vectorized unref — the
+    in-jit tail of :func:`serve_wave`. Inactive slots in ``slot_ids``
+    contribute zero pages (their ``seq_lens`` is 0); pages shared
+    between released forks are freed exactly once (the compaction in
+    :func:`_unref_pages` works per pool page, not per table entry)."""
+    _, page = _pool_geometry(state)
     max_pages = state.page_table.shape[1]
     n = slot_ids.shape[0]
     counts = -(-state.seq_lens[slot_ids] // page)              # (n,)
@@ -378,16 +420,82 @@ def paged_release_many(
         jax.lax.broadcasted_iota(jnp.int32, (n, max_pages), 1)
         < counts[:, None]
     ).reshape(-1)
-    rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
-    dest = jnp.where(alive, state.free_top + rank, num_pages)  # OOB drop
-    stack = state.free_stack.at[dest].set(
-        state.page_table[slot_ids].reshape(-1), mode="drop"
+    state = _unref_pages(
+        state, state.page_table[slot_ids].reshape(-1), alive
     )
     return state._replace(
-        free_stack=stack,
-        free_top=state.free_top + counts.sum(),
         active=state.active.at[slot_ids].set(False, mode="drop"),
         seq_lens=state.seq_lens.at[slot_ids].set(0, mode="drop"),
+    )
+
+
+def paged_fork(
+    state: PagedKVState, src: jax.Array, dst_slots: jax.Array
+) -> PagedKVState:
+    """Fork slot ``src``'s sequence into each slot of ``dst_slots``
+    (distinct, not containing ``src``): vLLM-style prefix sharing.
+
+    Every FULL page of the source is shared by reference — a slot only
+    ever writes at its own length, which lies past all full prefix
+    pages, so shared pages are naturally copy-on-write-free read-only.
+    A partial tail page (``seq_lens[src] % page != 0``) WILL receive the
+    fork's future writes, so each destination gets its own copy (one
+    page DMA per fork, the entire fork cost). The pool then holds the
+    prefix ONCE plus one tail page per fork, instead of once per
+    branch — the memory and prefill lever behind
+    :meth:`ContinuousBatcher.run_what_if`.
+
+    Destinations become active at the source's length; the source keeps
+    running (its tail page stays exclusively its own). All work is
+    masked/vectorized — safe inside jit at static ``dst_slots`` width.
+    """
+    num_pages, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    k = dst_slots.shape[0]
+    length = state.seq_lens[src]
+    n_full = length // page                  # fully-shared pages
+    has_tail = (length % page) != 0
+    src_row = state.page_table[src]
+
+    # share the full prefix pages: +1 reference per fork
+    share_alive = jnp.arange(max_pages) < n_full
+    ref = state.page_ref.at[
+        jnp.where(share_alive, src_row, num_pages)
+    ].add(k, mode="drop")
+    state = state._replace(page_ref=ref)
+
+    # one fresh page per fork for the tail copy (masked off if none)
+    need = jnp.broadcast_to(has_tail, (k,))
+    pages, new_top, ref, failed = _pop_pages(state, need)
+    tail_col = jnp.clip(n_full, 0, max_pages - 1)
+    src_tail = src_row[tail_col]
+    dest = jnp.where(need, pages, num_pages)  # OOB -> dropped copy
+
+    def copy_tail(pool):
+        if isinstance(pool, QuantizedPool):
+            return QuantizedPool(
+                pool.values.at[dest].set(pool.values[src_tail], mode="drop"),
+                pool.scales.at[dest].set(pool.scales[src_tail], mode="drop"),
+            )
+        return pool.at[dest].set(pool[src_tail], mode="drop")
+
+    # destination table rows: shared prefix + own tail page
+    row = jnp.where(share_alive, src_row, 0)
+    rows = jnp.broadcast_to(row, (k, max_pages))
+    rows = jnp.where(
+        (jnp.arange(max_pages)[None, :] == tail_col) & need[:, None],
+        pages[:, None],
+        rows,
+    )
+    return state._replace(
+        k_pools=tuple(copy_tail(p) for p in state.k_pools),
+        v_pools=tuple(copy_tail(p) for p in state.v_pools),
+        page_table=state.page_table.at[dst_slots].set(rows, mode="drop"),
+        seq_lens=state.seq_lens.at[dst_slots].set(length, mode="drop"),
+        active=state.active.at[dst_slots].set(True, mode="drop"),
+        free_top=new_top,
+        page_ref=ref,
+        alloc_failed=failed,
     )
 
 
@@ -441,15 +549,35 @@ def serve_wave(
     ``horizons`` tuple, a tuple of per-request ``(horizons[i],)``
     forecast arrays trimmed in-program."""
     n = feats_padded.shape[0]
-    slots = state.page_table.shape[0]
-    slot_ids = jnp.arange(n, dtype=jnp.int32)
     preds, state = paged_admit_batch(
-        model, params, state, slot_ids, feats_padded, prefix_lens
+        model, params, state, jnp.arange(n, dtype=jnp.int32),
+        feats_padded, prefix_lens,
     )
+    deltas, state = _roll_and_release(
+        model, params, state, preds, last_statuses, n, n_ticks
+    )
+    if horizons is not None:
+        # per-request trims INSIDE the program: an eager row slice after
+        # the fact costs an extra dispatch per request (~1 ms each over
+        # a tunnel), a traced slice is free
+        return tuple(deltas[i, : horizons[i]] for i in range(n)), state
+    return deltas[:n], state
+
+
+def _roll_and_release(
+    model, params, state: PagedKVState, preds, status_ids, n: int,
+    n_ticks: int,
+):
+    """Shared tail of :func:`serve_wave` / :func:`fork_wave`: scatter
+    the admit predictions and frozen per-slot status one-hots into
+    slot-wide carriers, roll ``n_ticks`` feedback steps on device
+    (:func:`paged_wave`), release slots ``0..n-1``. Returns the full
+    (slots, n_ticks + 1) delta matrix and the state."""
+    slots = state.page_table.shape[0]
     status_oh = (
         jnp.zeros((slots, NUM_STATUSES), jnp.float32)
         .at[:n]
-        .set(jax.nn.one_hot(last_statuses, NUM_STATUSES))
+        .set(jax.nn.one_hot(status_ids, NUM_STATUSES))
     )
     pred0 = jnp.zeros((slots,), jnp.float32).at[:n].set(
         preds.astype(jnp.float32)
@@ -457,13 +585,50 @@ def serve_wave(
     deltas, state = paged_wave(
         model, params, state, pred0, status_oh, n_ticks
     )
-    state = paged_release_many(state, slot_ids)
-    if horizons is not None:
-        # per-request trims INSIDE the program: an eager row slice after
-        # the fact costs an extra dispatch per request (~1 ms each over
-        # a tunnel), a traced slice is free
-        return tuple(deltas[i, : horizons[i]] for i in range(n)), state
-    return deltas[:n], state
+    state = paged_release_many(state, jnp.arange(n, dtype=jnp.int32))
+    return deltas, state
+
+
+def fork_wave(
+    model: TelemetrySequenceModel,
+    params,
+    state: PagedKVState,
+    feats_padded: jax.Array,
+    prefix_len: jax.Array,
+    branch_statuses: jax.Array,
+    n_ticks: int,
+):
+    """What-if forecasting as ONE compiled program: prefill a single
+    telemetry prefix ONCE (slot 0), :func:`paged_fork` it into ``k - 1``
+    more slots, pin each slot's frozen status one-hot to its own
+    hypothetical branch (``branch_statuses`` (k,) — e.g. "what does the
+    forecast look like if the job were DEPLOYED vs ERRORED from here"),
+    roll all branches ``n_ticks`` feedback steps in one scan, release.
+
+    Against admitting ``k`` copies (:func:`serve_wave`), the prefill
+    runs once instead of ``k`` times and the pool holds the prefix once
+    plus one tail page per branch — both prefill FLOPs and cache bytes
+    stop scaling with the branch count. Branch 0 reads the source pages
+    themselves; its forecast is bit-identical to an unforked rollout.
+
+    Returns ((k, n_ticks + 1) forecast deltas, state)."""
+    k = branch_statuses.shape[0]
+    if feats_padded.shape[0] != 1:
+        raise ValueError(
+            f"fork_wave takes ONE prefix, got {feats_padded.shape[0]}"
+        )
+    preds, state = paged_admit_batch(
+        model, params, state, jnp.zeros((1,), jnp.int32), feats_padded,
+        jnp.asarray(prefix_len, jnp.int32).reshape(1),
+    )
+    state = paged_fork(
+        state, jnp.int32(0), jnp.arange(1, k, dtype=jnp.int32)
+    )
+    deltas, state = _roll_and_release(
+        model, params, state, jnp.broadcast_to(preds[0], (k,)),
+        branch_statuses, k, n_ticks,
+    )
+    return deltas[:k], state
 
 
 class _RunCarry(NamedTuple):
@@ -643,6 +808,18 @@ class ContinuousBatcher:
     def _pad_to(self, feats: np.ndarray, width: int) -> np.ndarray:
         return np.pad(feats, ((0, width - feats.shape[0]), (0, 0)))
 
+    def _check_not_poisoned(self):
+        if self._poisoned:
+            raise RuntimeError(
+                "batcher state undefined after an earlier mid-run error "
+                "— construct a fresh ContinuousBatcher"
+            )
+
+    _ALLOCATOR_TRIPPED = (
+        "page pool exhausted mid-run (device allocator tripped despite "
+        "host headroom checks) — raise num_pages"
+    )
+
     def _check_servable(self, req: Request):
         need = self._need_pages(req)
         if need > self.num_pages or need > self.max_pages_per_seq:
@@ -661,11 +838,7 @@ class ContinuousBatcher:
         escapes mid-run (allocator safety net, device error) POISONS the
         batcher — the host's free-page arithmetic would no longer mirror
         the device allocator — and every later call refuses to run."""
-        if self._poisoned:
-            raise RuntimeError(
-                "batcher state undefined after an earlier mid-run error "
-                "— construct a fresh ContinuousBatcher"
-            )
+        self._check_not_poisoned()
         for req in requests:
             if req.horizon <= 0:
                 continue
@@ -814,10 +987,7 @@ class ContinuousBatcher:
                 flat.append(head)
         got = jax.device_get(flat)
         if got[0]:
-            raise RuntimeError(
-                "page pool exhausted mid-run (device allocator tripped "
-                "despite host headroom checks) — raise num_pages"
-            )
+            raise RuntimeError(self._ALLOCATOR_TRIPPED)
         i = 1
         for rid, (head, _) in snaps.items():
             tail_v = np.float32(got[i])
@@ -833,20 +1003,24 @@ class ContinuousBatcher:
 
     # -- throughput path: on-device waves -------------------------------
 
+    def _cached_jit(self, key: tuple, build):
+        """One compiled program per static shape key (wave width, scan
+        length, trims): jit on first use, reuse after."""
+        fn = self._serve_cache.get(key)
+        if fn is None:
+            fn = jax.jit(build())
+            self._serve_cache[key] = fn
+        return fn
+
     def _serve_fn(
         self, n: int, n_ticks: int, horizons: tuple[int, ...] | None = None
     ):
-        key = (n, n_ticks, horizons)
-        fn = self._serve_cache.get(key)
-        if fn is None:
-            fn = jax.jit(
-                lambda p, s, f, ln, st: serve_wave(
-                    self.model, p, s, f, ln, st, n_ticks,
-                    horizons=horizons,
-                )
-            )
-            self._serve_cache[key] = fn
-        return fn
+        return self._cached_jit(
+            (n, n_ticks, horizons),
+            lambda: lambda p, s, f, ln, st: serve_wave(
+                self.model, p, s, f, ln, st, n_ticks, horizons=horizons
+            ),
+        )
 
     def run_waves(
         self, requests: list[Request], device_results: bool = False
@@ -954,13 +1128,92 @@ class ContinuousBatcher:
             [d for _, d in batches] + [self.state.alloc_failed]
         )
         if fetched[-1]:
-            raise RuntimeError(
-                "page pool exhausted (device allocator tripped despite "
-                "host headroom checks) — raise num_pages"
-            )
+            raise RuntimeError(self._ALLOCATOR_TRIPPED)
         for (wave, _), arr in zip(batches, fetched):
             for i, (rid, req) in enumerate(wave):
                 results[rid] = np.asarray(
                     arr[i, : req.horizon], np.float32
                 )
         return results
+
+    # -- what-if path: one prefix, many hypothetical futures ------------
+
+    def run_what_if(
+        self,
+        progress: np.ndarray,
+        statuses: np.ndarray,
+        branch_statuses: list[int],
+        horizon: int,
+    ) -> np.ndarray:
+        """Forecast ONE observed telemetry stream under ``k`` hypothetical
+        status branches ("how does the remaining time change if the job
+        goes to DEPLOYED vs ERRORED from here"): the prefix is prefilled
+        ONCE, its full pages shared across branches (:func:`paged_fork`),
+        and all branches roll together in one compiled program
+        (:func:`fork_wave`). Cost vs ``k`` independent requests: 1/k of
+        the prefill FLOPs, and the pool holds the prefix once plus one
+        tail page per branch. Returns (k, horizon) forecast deltas."""
+        k = len(branch_statuses)
+        if not 1 <= k <= self.slots:
+            raise ValueError(
+                f"branches {k} must be in [1, slots={self.slots}]"
+            )
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        bad = [
+            s for s in branch_statuses if not 0 <= int(s) < NUM_STATUSES
+        ]
+        if bad:
+            # an out-of-range status would one-hot to an all-zeros row —
+            # a silently status-blind branch, not an error
+            raise ValueError(
+                f"branch statuses {bad} out of range [0, {NUM_STATUSES})"
+            )
+        self._check_not_poisoned()
+        req = Request(
+            np.asarray(progress), np.asarray(statuses), horizon
+        )
+        feats_np, t = self._prep_np(req)
+        if t == 0:
+            # must fail HERE with the other pre-checks: a (1, 0, F)
+            # prefill inside the traced program would raise mid-flight
+            # and needlessly poison a batcher that admitted nothing
+            raise ValueError(
+                "prefix must contain at least one observed delta "
+                "(progress needs >= 2 samples)"
+            )
+        n_ticks = horizon - 1
+        end_pages = -(-(t + n_ticks) // self.page_size)
+        shared = t // self.page_size
+        need = shared + k * (end_pages - shared)
+        if end_pages > self.max_pages_per_seq or need > self.num_pages:
+            raise RuntimeError(
+                f"page pool exhausted: {k} branches of a {t}-token "
+                f"prefix at horizon {horizon} need {need} pages "
+                f"(pool {self.num_pages}, per-seq cap "
+                f"{self.max_pages_per_seq})"
+            )
+        t_pad = -(-t // self.page_size) * self.page_size
+        fn = self._cached_jit(
+            ("what_if", k, n_ticks, t_pad),
+            lambda: lambda p, s, f, ln, br: fork_wave(
+                self.model, p, s, f, ln, br, n_ticks
+            ),
+        )
+        try:
+            deltas, self.state = fn(
+                self.params, self.state,
+                jnp.asarray(self._pad_to(feats_np, t_pad))[None],
+                jnp.int32(t),
+                jnp.asarray(branch_statuses, jnp.int32),
+            )
+            out, failed = jax.device_get(
+                [deltas, self.state.alloc_failed]
+            )
+        except BaseException:
+            self._poisoned = True
+            raise
+        if failed:
+            self._poisoned = True
+            raise RuntimeError(self._ALLOCATOR_TRIPPED)
+        return np.asarray(out[:, :horizon], np.float32)

@@ -469,3 +469,168 @@ def test_tick_chunk_equals_per_tick_loop():
     np.testing.assert_array_equal(
         np.asarray(st_c.seq_lens), np.asarray(st_l.seq_lens)
     )
+
+
+@pytest.mark.parametrize("cache_dtype", [jnp.bfloat16, "int8"],
+                         ids=["bf16", "int8"])
+def test_fork_matches_independent_admissions(cache_dtype):
+    """paged_fork + teacher-forced ticks == admitting the same request
+    into every slot independently. Slot 0's pages are bit-shared with
+    the forks' prefixes and the tail copy is a bitwise page copy (for
+    int8 pools: values AND scales), so the decode kernel reads
+    identical bytes either way — predictions must agree to float
+    determinism, not just tolerance."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    params = state0.params
+    t = 13  # page=8: one full shared page + a 5-token tail copy
+    req = _request(0, t=t, horizon=0)
+    f = _feats(req)
+    fpad = jnp.pad(f, ((0, 0), (0, 16 - t), (0, 0)))
+    oh = np.asarray(
+        jax.nn.one_hot(TelemetryStatusEntry.CONVERTING, NUM_STATUSES)
+    )
+    rng = np.random.default_rng(5)
+    forced = rng.normal(0, 1, (6, 3)).astype(np.float32)
+
+    forked = sv.init_paged(model, num_pages=16, page_size=8, slots=3,
+                           max_pages_per_seq=4, cache_dtype=cache_dtype)
+    _, forked = sv.paged_admit(model, params, forked, jnp.int32(0),
+                               fpad, jnp.int32(t))
+    forked = sv.paged_fork(
+        forked, jnp.int32(0), jnp.asarray([1, 2], jnp.int32)
+    )
+    indep = sv.init_paged(model, num_pages=16, page_size=8, slots=3,
+                          max_pages_per_seq=4, cache_dtype=cache_dtype)
+    for slot in range(3):
+        _, indep = sv.paged_admit(model, params, indep, jnp.int32(slot),
+                                  fpad, jnp.int32(t))
+
+    np.testing.assert_array_equal(
+        np.asarray(forked.seq_lens), np.asarray(indep.seq_lens)
+    )
+    for tick in range(6):
+        feats_t = jnp.asarray(
+            np.concatenate(
+                [forced[tick][:, None], np.stack([oh] * 3)], axis=1
+            ),
+            jnp.float32,
+        )
+        pf, forked = sv.paged_decode_tick(model, params, forked, feats_t)
+        pi, indep = sv.paged_decode_tick(model, params, indep, feats_t)
+        np.testing.assert_allclose(
+            np.asarray(pf), np.asarray(pi), rtol=1e-6, atol=1e-7,
+            err_msg=f"tick {tick}",
+        )
+    assert not bool(forked.alloc_failed)
+
+
+def test_fork_shares_pages_and_refcounts_release():
+    """The allocator story: forks consume one tail page each (the full
+    prefix pages are shared with refcounts), shared pages survive until
+    their LAST owner releases, and the pool drains back to full."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state0, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    st = sv.init_paged(model, num_pages=16, page_size=8, slots=4,
+                       max_pages_per_seq=4)
+    t = 21  # 2 full pages + 5-token tail
+    f = _feats(_request(0, t=t, horizon=0))
+    fpad = jnp.pad(f, ((0, 0), (0, 24 - t), (0, 0)))
+    _, st = sv.paged_admit(model, state0.params, st, jnp.int32(0),
+                           fpad, jnp.int32(t))
+    assert int(st.free_top) == 13  # 3 pages: 2 full + tail
+    st = sv.paged_fork(st, jnp.int32(0), jnp.asarray([1, 2, 3], jnp.int32))
+    # 3 forks cost ONE page each (own tail copy); prefix shared
+    assert int(st.free_top) == 10
+    shared = np.asarray(st.page_table[0][:2])
+    ref = np.asarray(st.page_ref)
+    assert all(ref[p] == 4 for p in shared)  # src + 3 forks
+    # every fork sees the same prefix pages but its own tail
+    for slot in (1, 2, 3):
+        row = np.asarray(st.page_table[slot])
+        np.testing.assert_array_equal(row[:2], shared)
+        assert row[2] != int(st.page_table[0][2])
+    # releasing two forks frees only their tails
+    st = sv.paged_release_many(st, jnp.asarray([1, 2], jnp.int32))
+    assert int(st.free_top) == 12
+    assert all(np.asarray(st.page_ref)[shared] == 2)
+    # last two owners: all pages come home
+    st = sv.paged_release_many(st, jnp.asarray([0, 3], jnp.int32))
+    assert int(st.free_top) == 16
+    assert not np.asarray(st.page_ref).any()
+    assert set(np.asarray(st.free_stack).tolist()) == set(range(16))
+
+
+def test_run_what_if_branches():
+    """run_what_if(k branches): branch with the observed status equals
+    the plain single-request forecast; a different hypothetical status
+    changes the forecast; pages all come home."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    req = _request(3, t=13, horizon=6)
+    branches = [
+        TelemetryStatusEntry.CONVERTING,
+        TelemetryStatusEntry.DEPLOYED,
+        TelemetryStatusEntry.ERRORED,
+    ]
+
+    def mk():
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=16, page_size=8, slots=4, max_prefix=16,
+            max_pages_per_seq=4,
+        )
+
+    b = mk()
+    got = b.run_what_if(req.progress, req.statuses, branches, horizon=6)
+    assert got.shape == (3, 6)
+    assert int(b.state.free_top) == 16
+    assert not bool(b.state.active.any())
+
+    # branch 0 carries the stream's real status -> must equal the plain
+    # rollout of the same request (identical pages, identical programs)
+    (want,) = mk().run_waves([req])
+    np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-6)
+    # a hypothetical status flips the feedback features -> forecasts
+    # must actually diverge (the one-hot is live, not decorative)
+    assert not np.allclose(got[0], got[1], atol=1e-4)
+
+    # reusable after a what-if, and composable with normal serving
+    (again,) = b.run_waves([req])
+    np.testing.assert_allclose(again, want, rtol=1e-5, atol=1e-6)
+
+
+def test_run_what_if_exhaustion_fails_fast():
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(1), 16, model=model)
+    b = ContinuousBatcher(
+        model, state.params,
+        num_pages=4, page_size=8, slots=4, max_prefix=16,
+        max_pages_per_seq=4,
+    )
+    req = _request(0, t=13, horizon=10)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        b.run_what_if(req.progress, req.statuses, [0, 1, 2], horizon=10)
+    assert int(b.state.free_top) == 4  # nothing admitted
+    # not poisoned: the check ran before any device work
+    got = b.run_what_if(req.progress, req.statuses, [0], horizon=2)
+    assert got.shape == (1, 2)
+
+
+def test_run_what_if_empty_prefix_fails_fast():
+    """A single-observation stream (zero deltas) must fail the cheap
+    pre-checks, NOT raise inside the traced program and poison the
+    batcher (review finding, round 5)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(1), 16, model=model)
+    b = ContinuousBatcher(
+        model, state.params,
+        num_pages=8, page_size=8, slots=2, max_prefix=16,
+        max_pages_per_seq=4,
+    )
+    with pytest.raises(ValueError, match="at least one observed delta"):
+        b.run_what_if(np.asarray([1.0]), np.asarray([2]), [2], horizon=4)
+    # not poisoned: a real request still serves
+    req = _request(0, t=10, horizon=3)
+    got = b.run_what_if(req.progress, req.statuses, [2], horizon=3)
+    assert got.shape == (1, 3)
