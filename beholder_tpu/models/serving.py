@@ -393,14 +393,8 @@ def paged_admit_batch(
 def paged_release(state: PagedKVState, slot: jax.Array) -> PagedKVState:
     """Retire ``slot``: drop one reference from each of its pages;
     pages nobody else shares go back on the free stack."""
-    _, page = _pool_geometry(state)
-    max_pages = state.page_table.shape[1]
-    n = -(-state.seq_lens[slot] // page)
-    alive = jnp.arange(max_pages) < n
-    state = _unref_pages(state, state.page_table[slot], alive)
-    return state._replace(
-        active=state.active.at[slot].set(False),
-        seq_lens=state.seq_lens.at[slot].set(0),
+    return paged_release_many(
+        state, jnp.asarray(slot, jnp.int32).reshape(1)
     )
 
 
@@ -642,19 +636,26 @@ class _RunCarry(NamedTuple):
     delta_buf: jax.Array  # (slots, cap) f32; tick t writes column t
 
 
-def _admit_with_carry(
-    model, params, state, carry: _RunCarry, slot, feats_padded, prefix_len,
-    last_status,
+def _admit_many_carry(
+    model, params, state, carry: _RunCarry, slot_ids, feats_padded,
+    prefix_lens, last_statuses,
 ):
-    """Admit one request and record its prefill prediction + status
-    one-hot in the device carry (no values cross to the host)."""
-    pred, state = paged_admit(
-        model, params, state, slot, feats_padded, prefix_len
+    """Admit a batch of requests in ONE program (one batched prefill —
+    :func:`paged_admit_batch`) and record their prefill predictions +
+    status one-hots in the device carry (no values cross to the host).
+    Per-request admits used to cost one dispatch EACH; over a tunnel
+    where dispatch+transfer latency dominates sub-ms programs, batching
+    the admission round is what keeps :meth:`ContinuousBatcher.run`'s
+    host traffic per scheduling EVENT, not per request."""
+    preds, state = paged_admit_batch(
+        model, params, state, slot_ids, feats_padded, prefix_lens
     )
     return state, carry._replace(
-        last_pred=carry.last_pred.at[slot].set(pred.astype(jnp.float32)),
-        status_oh=carry.status_oh.at[slot].set(
-            jax.nn.one_hot(last_status, NUM_STATUSES)
+        last_pred=carry.last_pred.at[slot_ids].set(
+            preds.astype(jnp.float32)
+        ),
+        status_oh=carry.status_oh.at[slot_ids].set(
+            jax.nn.one_hot(last_statuses, NUM_STATUSES)
         ),
     )
 
@@ -754,12 +755,7 @@ class ContinuousBatcher:
             cache_dtype=cache_dtype,
         )
         self.slots = slots
-        self._release = jax.jit(paged_release)
-        self._admit_carry = jax.jit(
-            lambda p, s, c, slot, feats, n, st: _admit_with_carry(
-                model, p, s, c, slot, feats, n, st
-            )
-        )
+        self._release_many = jax.jit(paged_release_many)
         self._tick_carry = jax.jit(
             lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
         )
@@ -856,16 +852,21 @@ class ContinuousBatcher:
         only touches the host at scheduling events (admissions and
         retirements); the event-free stretches between them — every
         tick until the earliest retirement — run as one device program
-        (:func:`_tick_chunk`). Retirement snapshots a slot's forecast
-        row as a device array (async slice, no sync); everything is
-        read back in one ``jax.device_get`` at the end.
+        (:func:`_tick_chunk`), each admission round is ONE batched
+        prefill (:func:`_admit_many_carry`), and each retirement round
+        is three dispatches total. Retirement snapshots forecast rows as
+        device arrays (async gathers, no sync); everything comes back in
+        one single-buffer ``jax.device_get`` at the end.
 
         This is the flexibility path — requests admit the moment a slot
-        frees up, so mixed-horizon fleets keep all slots busy — and
-        since round 5's event-chunking its throughput approaches
-        :meth:`run_waves` (which still wins by fusing admission and
-        release into the same program). Both are measured side by side
-        in ``bench.py`` (``serving.run_value`` vs ``serving.value``)."""
+        frees up, so mixed-horizon fleets keep all slots busy.
+        :meth:`run_waves` still wins on throughput by fusing admission,
+        scan, and release into one program per wave AND deferring its
+        readback (``device_results=True``), which run() cannot: its
+        contract returns host arrays, so one d2h crossing (~65 ms on
+        this tunnel) is part of every call. Both paths are measured side
+        by side in ``bench.py`` (``serving.run_value`` vs
+        ``serving.value``)."""
         self._start_run(requests)
         try:
             return self._run(requests)
@@ -890,7 +891,9 @@ class ContinuousBatcher:
         remaining = np.zeros(self.slots, np.int64)
         total_need = np.zeros(self.slots, np.int64)  # pages at horizon end
         written = np.zeros(self.slots, np.int64)     # forecast entries
-        snaps: dict[int, tuple] = {}  # rid -> (head | None, tail) on device
+        # each scheduling event appends ONE batch: (rids, (R, cap) rows,
+        # (R,) tails, per-rid live widths) — rows/tails device-resident
+        snap_batches: list[tuple[list, jax.Array, jax.Array, list]] = []
 
         def free_pages() -> int:
             """Free pages after honoring every active slot's worst-case
@@ -900,22 +903,34 @@ class ContinuousBatcher:
             growth, so no device read is needed."""
             return self.num_pages - int(total_need.sum())
 
-        def retire(slot):
-            """Snapshot the slot's forecast WITHOUT running another tick
-            (the horizon-th prediction is last_pred itself; a tick for
-            it could allocate a page for a token nobody reads). The
-            snapshot is an async device slice — fetched at the end."""
-            w = int(written[slot])
-            snaps[req_of[slot]] = (
-                carry.delta_buf[slot, :w] if w else None,
-                carry.last_pred[slot],
-            )
-            self.state = self._release(self.state, jnp.int32(slot))
-            req_of[slot] = None
-            total_need[slot] = 0
-            written[slot] = 0
+        def retire_many(done: list[int]):
+            """Snapshot + release a retirement round in THREE dispatches
+            (two batched gathers + one vectorized release) regardless of
+            how many slots finish together. No extra tick runs (the
+            horizon-th prediction is last_pred itself; a tick for it
+            could allocate a page for a token nobody reads), and nothing
+            crosses to the host — full (cap,) rows are gathered so every
+            event's snapshot has a packable shape, with the live widths
+            riding along host-side for the post-fetch trim."""
+            idx = jnp.asarray(done, jnp.int32)
+            snap_batches.append((
+                [req_of[s] for s in done],
+                carry.delta_buf[idx],
+                carry.last_pred[idx],
+                [int(written[s]) for s in done],
+            ))
+            self.state = self._release_many(self.state, idx)
+            for s in done:
+                req_of[s] = None
+                total_need[s] = 0
+                written[s] = 0
 
         while queue or any(r is not None for r in req_of):
+            # admission round: claim every (slot, request) pair that fits
+            # under the page-headroom arithmetic, then admit them all in
+            # ONE batched-prefill dispatch (host traffic per scheduling
+            # EVENT, not per request)
+            batch: list[tuple[int, int, np.ndarray, int]] = []
             for slot in range(self.slots):
                 if not queue or req_of[slot] is not None:
                     continue
@@ -939,19 +954,36 @@ class ContinuousBatcher:
                     break  # defer until an active request retires
                 queue.pop(0)
                 feats_np, t = self._prep_np(req)
-                t_pad = -(-t // self.page_size) * self.page_size
-                self.state, carry = self._admit_carry(
-                    self.params, self.state, carry, jnp.int32(slot),
-                    jnp.asarray(self._pad_to(feats_np, t_pad))[None],
-                    jnp.int32(t),
-                    jnp.int32(int(req.statuses[-1])),
-                )
+                batch.append((slot, rid, feats_np, t))
                 req_of[slot] = rid
                 remaining[slot] = req.horizon
                 total_need[slot] = need
                 written[slot] = 0
-                if remaining[slot] == 1:
-                    retire(slot)  # the admit prediction was the forecast
+            if batch:
+                t_pad = -(
+                    -max(t for _, _, _, t in batch) // self.page_size
+                ) * self.page_size
+                admit = self._cached_jit(
+                    ("admit", len(batch), t_pad),
+                    lambda: lambda p, s, c, ids, f, ln, st: (
+                        _admit_many_carry(self.model, p, s, c, ids, f, ln, st)
+                    ),
+                )
+                self.state, carry = admit(
+                    self.params, self.state, carry,
+                    jnp.asarray([s for s, _, _, _ in batch], jnp.int32),
+                    jnp.asarray(np.stack(
+                        [self._pad_to(f, t_pad) for _, _, f, _ in batch]
+                    )),
+                    jnp.asarray([t for _, _, _, t in batch], jnp.int32),
+                    jnp.asarray(
+                        [int(requests[r].statuses[-1]) for _, r, _, _ in batch],
+                        jnp.int32,
+                    ),
+                )
+                done = [s for s, _, _, _ in batch if remaining[s] == 1]
+                if done:
+                    retire_many(done)  # admit predictions WERE the forecasts
 
             if not any(r is not None for r in req_of):
                 continue
@@ -971,34 +1003,45 @@ class ContinuousBatcher:
                 self.params, self.state, carry, jnp.asarray(write_idx),
                 jnp.int32(n_chunk),
             )
+            done = []
             for slot in range(self.slots):
                 if req_of[slot] is None:
                     continue
                 written[slot] += n_chunk
                 remaining[slot] -= n_chunk
                 if remaining[slot] <= 1:
-                    retire(slot)
+                    done.append(slot)
+            if done:
+                retire_many(done)
 
-        # ONE host readback: the allocator flag plus every snapshot
-        flat: list = [self.state.alloc_failed]
-        for head, tail in snaps.values():
-            flat.append(tail)
-            if head is not None:
-                flat.append(head)
-        got = jax.device_get(flat)
-        if got[0]:
+        # ONE host readback of ONE buffer: this tunnel charges its
+        # ~65 ms d2h constant PER BUFFER, not per call — a device_get
+        # over the 2R+1 separate snapshot arrays cost ~R readbacks and
+        # capped run() at ~2k tok/s (measured round 5) — so the flag,
+        # tails, and rows are packed into a single flat device array
+        # first (a few ~20 us dispatches) and fetched in one crossing
+        if snap_batches:
+            rows = jnp.concatenate([b[1] for b in snap_batches])
+            tails = jnp.concatenate([b[2] for b in snap_batches])
+            packed = jnp.concatenate(
+                [
+                    self.state.alloc_failed.astype(jnp.float32)[None],
+                    tails.astype(jnp.float32),
+                    rows.reshape(-1),
+                ]
+            )
+            got = np.asarray(jax.device_get(packed), np.float32)
+            if got[0]:
+                raise RuntimeError(self._ALLOCATOR_TRIPPED)
+            rids = [rid for b in snap_batches for rid in b[0]]
+            widths = [w for b in snap_batches for w in b[3]]
+            r = len(rids)
+            tails_v = got[1 : 1 + r]
+            rows_v = got[1 + r :].reshape(r, cap)
+            for i, (rid, w) in enumerate(zip(rids, widths)):
+                results[rid] = np.append(rows_v[i, :w], tails_v[i])
+        elif bool(jax.device_get(self.state.alloc_failed)):
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
-        i = 1
-        for rid, (head, _) in snaps.items():
-            tail_v = np.float32(got[i])
-            i += 1
-            if head is not None:
-                results[rid] = np.append(
-                    np.asarray(got[i], np.float32), tail_v
-                )
-                i += 1
-            else:
-                results[rid] = np.asarray([tail_v], np.float32)
         return results
 
     # -- throughput path: on-device waves -------------------------------
@@ -1207,13 +1250,21 @@ class ContinuousBatcher:
                 jnp.int32(t),
                 jnp.asarray(branch_statuses, jnp.int32),
             )
-            out, failed = jax.device_get(
-                [deltas, self.state.alloc_failed]
+            # flag + deltas packed into ONE buffer before the fetch —
+            # the tunnel charges its ~65 ms d2h constant per BUFFER
+            # (same packing as run()'s final readback)
+            packed = jnp.concatenate(
+                [
+                    self.state.alloc_failed.astype(jnp.float32)[None],
+                    deltas.astype(jnp.float32).reshape(-1),
+                ]
             )
+            got = np.asarray(jax.device_get(packed), np.float32)
         except BaseException:
             self._poisoned = True
             raise
-        if failed:
+        if got[0]:
             self._poisoned = True
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
+        out = got[1:].reshape(k, n_ticks + 1)
         return np.asarray(out[:, :horizon], np.float32)

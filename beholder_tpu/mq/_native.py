@@ -100,6 +100,17 @@ def available() -> bool:
     return _lib is not None or _ext is not None
 
 
+def reset() -> None:
+    """Re-probe for the built artifacts. Import-time loading means a
+    ``make native`` run AFTER this module was imported (e.g. bench.py
+    auto-building in a fresh checkout) would otherwise go unseen."""
+    global _lib, _ext
+    if _lib is None:
+        _lib = _load()
+    if _ext is None:
+        _ext = _load_ext()
+
+
 def ext_available() -> bool:
     return _ext is not None
 

@@ -183,13 +183,18 @@ def ring_attention(
     ``backend="flash"`` (default) runs each rotation's local block
     attend INSIDE the Pallas flash kernels — the masks take the rotated
     block's global row offsets. Measured honestly (BENCH r05
-    ``ring_block``, slope-timed on v5e at T/P=2048): the kernel is at
-    PARITY with the XLA einsum block-attend on BOTH the fully-live
-    mid-ring rotation and the half-masked diagonal one (~0.96x each) —
-    round 3's premise that the distributed path was "running at einsum
-    rate, not kernel rate" did not survive tunnel-robust timing. The
-    kernel stays the default for MEMORY, not speed: it runs in O(block)
-    VMEM while the einsum materializes the (T/P, T/P) f32 score block
+    ``ring_block``, slope-timed on v5e at T/P=2048, both rotation
+    types): the ratios move with chip contention. On a heavily shared
+    chip the kernel sits at parity with the XLA einsum block-attend on
+    both the fully-live mid-ring rotation and the half-masked diagonal
+    (~0.96x each); on a quiet chip the kernel wins the mid-ring
+    rotation ~1.7x while the einsum wins the packed-causal diagonal
+    ~1.7x (kernel 0.58x there). A P-device causal ring runs ONE
+    diagonal and up to P-1 mid-ring rotations per device — and the
+    diagonal carries half the FLOPs — so the kernel is the better net
+    choice for P >= 2 whenever it wins the rotations, and no worse than
+    ~6% off at parity. It is ALWAYS the memory-safe choice: O(block)
+    VMEM, while the einsum materializes the (T/P, T/P) f32 score block
     per head group (134 MB at T/P=2048, growing quadratically with the
     shard). The forward combines each pair's (o, logsumexp) with the
     online-softmax recurrence; the backward recomputes each pair's

@@ -443,10 +443,11 @@ def test_tick_chunk_equals_per_tick_loop():
     )
     for slot, t in ((0, 13), (1, 9)):
         f = _feats(_request(slot, t=t, horizon=0))
-        st, carry = sv._admit_with_carry(
-            model, state0.params, st, carry, jnp.int32(slot),
+        st, carry = sv._admit_many_carry(
+            model, state0.params, st, carry,
+            jnp.asarray([slot], jnp.int32),
             jnp.pad(f, ((0, 0), (0, 16 - f.shape[1]), (0, 0))),
-            jnp.int32(t), jnp.int32(2),
+            jnp.asarray([t], jnp.int32), jnp.asarray([2], jnp.int32),
         )
 
     w0 = jnp.asarray([0, 0], jnp.int32)
