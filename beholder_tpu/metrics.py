@@ -102,23 +102,73 @@ def _fmt(value: float) -> str:
     return str(int(value)) if value == int(value) else repr(value)
 
 
-class Registry:
-    def __init__(self):
-        self._counters: list[Counter] = []
+class Gauge:
+    """A settable instantaneous value (classic ``# TYPE ... gauge``).
+
+    Extension surface — the reference exposes only the two counters, so
+    gauges never appear in the default :class:`Metrics` set (its
+    exposition stays byte-identical); they exist for extension
+    subsystems like the paged serving layer's pool instrumentation."""
+
+    def __init__(self, name: str, help: str):
+        self.name = name
+        self.help = help
+        self._value = 0.0
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help: str, labelnames: Iterable[str] = ()) -> Counter:
-        c = Counter(name, help, labelnames)
+    def set(self, value: float) -> None:
         with self._lock:
-            if any(existing.name == name for existing in self._counters):
-                raise ValueError(f"duplicate metric {name!r}")
-            self._counters.append(c)
-        return c
+            self._value = float(value)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
 
     def render(self) -> str:
         with self._lock:
-            counters = list(self._counters)
-        return "\n".join(c.render() for c in counters) + "\n"
+            value = self._value
+        return "\n".join(
+            [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt(value)}",
+            ]
+        )
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list = []
+        self._lock = threading.Lock()
+
+    def _register(self, metric):
+        with self._lock:
+            if any(existing.name == metric.name for existing in self._metrics):
+                raise ValueError(f"duplicate metric {metric.name!r}")
+            self._metrics.append(metric)
+        return metric
+
+    def find(self, name: str):
+        """The registered metric with ``name``, or None — lets a
+        re-created component (e.g. a fresh ContinuousBatcher after a
+        pool-exhaustion error) re-attach to its existing series instead
+        of tripping the duplicate guard."""
+        with self._lock:
+            for metric in self._metrics:
+                if metric.name == name:
+                    return metric
+        return None
+
+    def counter(self, name: str, help: str, labelnames: Iterable[str] = ()) -> Counter:
+        return self._register(Counter(name, help, labelnames))
+
+    def gauge(self, name: str, help: str) -> Gauge:
+        return self._register(Gauge(name, help))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        return "\n".join(m.render() for m in metrics) + "\n"
 
 
 class Metrics:

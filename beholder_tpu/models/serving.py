@@ -712,6 +712,55 @@ class Request(NamedTuple):
     horizon: int
 
 
+class _ServingMetrics:
+    """Prometheus instrumentation for one batcher (extension surface:
+    the reference's registry carries only its two counters — these
+    series appear ONLY when a registry is handed to
+    :class:`ContinuousBatcher`, so the default exposition stays
+    byte-identical to the reference). Every value comes from the
+    scheduler's host-side bookkeeping: instrumentation adds ZERO device
+    reads (the whole round-5 serving story)."""
+
+    def __init__(self, registry, num_pages: int):
+        def get_or_create(kind, name, help):
+            # a REPLACEMENT batcher (the documented recovery from a
+            # pool-exhaustion error) re-attaches to the service's
+            # existing series instead of tripping the duplicate guard
+            return registry.find(name) or getattr(registry, kind)(
+                name, help
+            )
+
+        self.pool_pages_free = get_or_create(
+            "gauge",
+            "beholder_serving_pool_pages_free",
+            "KV pages not reserved by any in-flight request",
+        )
+        self.slots_active = get_or_create(
+            "gauge",
+            "beholder_serving_slots_active",
+            "Serving slots holding an in-flight request",
+        )
+        self.requests_total = get_or_create(
+            "counter",
+            "beholder_serving_requests_total",
+            "Requests fully served by the paged serving layer",
+        )
+        self.tokens_total = get_or_create(
+            "counter",
+            "beholder_serving_tokens_total",
+            "Forecast tokens decoded by the paged serving layer",
+        )
+        self.pool_pages_free.set(num_pages)
+
+    def served(self, n_requests: int, n_tokens: int) -> None:
+        self.requests_total.inc(n_requests)
+        self.tokens_total.inc(n_tokens)
+
+    def idle(self, num_pages: int) -> None:
+        self.slots_active.set(0)
+        self.pool_pages_free.set(num_pages)
+
+
 class ContinuousBatcher:
     """Host-side vLLM-style scheduler over the paged state.
 
@@ -730,6 +779,15 @@ class ContinuousBatcher:
     ``alloc_failed`` flag is still checked once at the end as a safety
     net. After an exhaustion error the batcher's pool state is
     undefined — construct a fresh one.
+
+    ``metrics`` (a :class:`beholder_tpu.metrics.Registry`, or a
+    :class:`~beholder_tpu.metrics.Metrics` whose registry is used)
+    exports the scheduler's pool/slot occupancy as prometheus gauges
+    plus served-request/token counters alongside the service's own
+    series — the serving layer's telemetry rides the same /metrics
+    endpoint the reference exposes. Purely host-side (zero device
+    reads); omitted, nothing is registered and the reference exposition
+    stays byte-identical.
     """
 
     def __init__(
@@ -743,6 +801,7 @@ class ContinuousBatcher:
         max_prefix: int = 64,
         max_pages_per_seq: int = 32,
         cache_dtype=jnp.bfloat16,
+        metrics=None,
     ):
         self.model = model
         self.params = params
@@ -755,6 +814,13 @@ class ContinuousBatcher:
             cache_dtype=cache_dtype,
         )
         self.slots = slots
+        self._metrics = (
+            _ServingMetrics(
+                getattr(metrics, "registry", metrics), num_pages
+            )
+            if metrics is not None
+            else None
+        )
         self._release_many = jax.jit(paged_release_many)
         self._tick_carry = jax.jit(
             lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
@@ -894,6 +960,8 @@ class ContinuousBatcher:
         # each scheduling event appends ONE batch: (rids, (R, cap) rows,
         # (R,) tails, per-rid live widths) — rows/tails device-resident
         snap_batches: list[tuple[list, jax.Array, jax.Array, list]] = []
+        served = [0, 0]  # requests, tokens — counted into metrics only
+        # AFTER the allocator check (a failed run served nothing)
 
         def free_pages() -> int:
             """Free pages after honoring every active slot's worst-case
@@ -913,8 +981,9 @@ class ContinuousBatcher:
             event's snapshot has a packable shape, with the live widths
             riding along host-side for the post-fetch trim."""
             idx = jnp.asarray(done, jnp.int32)
+            rids = [req_of[s] for s in done]
             snap_batches.append((
-                [req_of[s] for s in done],
+                rids,
                 carry.delta_buf[idx],
                 carry.last_pred[idx],
                 [int(written[s]) for s in done],
@@ -924,6 +993,8 @@ class ContinuousBatcher:
                 req_of[s] = None
                 total_need[s] = 0
                 written[s] = 0
+            served[0] += len(done)
+            served[1] += sum(requests[r].horizon for r in rids)
 
         while queue or any(r is not None for r in req_of):
             # admission round: claim every (slot, request) pair that fits
@@ -984,6 +1055,11 @@ class ContinuousBatcher:
                 done = [s for s, _, _, _ in batch if remaining[s] == 1]
                 if done:
                     retire_many(done)  # admit predictions WERE the forecasts
+            if self._metrics:
+                self._metrics.slots_active.set(
+                    sum(r is not None for r in req_of)
+                )
+                self._metrics.pool_pages_free.set(free_pages())
 
             if not any(r is not None for r in req_of):
                 continue
@@ -1013,6 +1089,11 @@ class ContinuousBatcher:
                     done.append(slot)
             if done:
                 retire_many(done)
+                if self._metrics:
+                    self._metrics.slots_active.set(
+                        sum(r is not None for r in req_of)
+                    )
+                    self._metrics.pool_pages_free.set(free_pages())
 
         # ONE host readback of ONE buffer: this tunnel charges its
         # ~65 ms d2h constant PER BUFFER, not per call — a device_get
@@ -1042,6 +1123,8 @@ class ContinuousBatcher:
                 results[rid] = np.append(rows_v[i, :w], tails_v[i])
         elif bool(jax.device_get(self.state.alloc_failed)):
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
+        if self._metrics:
+            self._metrics.served(*served)
         return results
 
     # -- throughput path: on-device waves -------------------------------
@@ -1157,10 +1240,27 @@ class ContinuousBatcher:
                 jnp.asarray(lens), jnp.asarray(stats),
             )
             batches.append((wave, deltas))
+            if self._metrics:
+                # the most recently DISPATCHED wave's occupancy (dispatch
+                # is async; the device drains waves behind the loop).
+                # served counters wait for the end-of-run allocator check
+                self._metrics.slots_active.set(len(wave))
+                self._metrics.pool_pages_free.set(
+                    self.num_pages
+                    - sum(pages_at(r, horizon) for _, r in wave)
+                )
 
+        if self._metrics:
+            self._metrics.idle(self.num_pages)
+        n_served = sum(len(w) for w, _ in batches)
+        t_served = sum(req.horizon for w, _ in batches for _, req in w)
         if device_results:
             # each wave's deltas is already a tuple of per-request
-            # in-program-trimmed arrays — no eager slicing here
+            # in-program-trimmed arrays — no eager slicing here. The
+            # caller owns the alloc_failed check in this mode, so the
+            # served counters count DISPATCHED work here
+            if self._metrics:
+                self._metrics.served(n_served, t_served)
             for wave, rows in batches:
                 for (rid, _), row in zip(wave, rows):
                     results[rid] = row
@@ -1172,6 +1272,8 @@ class ContinuousBatcher:
         )
         if fetched[-1]:
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
+        if self._metrics:
+            self._metrics.served(n_served, t_served)
         for (wave, _), arr in zip(batches, fetched):
             for i, (rid, req) in enumerate(wave):
                 results[rid] = np.asarray(
@@ -1267,4 +1369,9 @@ class ContinuousBatcher:
             self._poisoned = True
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
         out = got[1:].reshape(k, n_ticks + 1)
+        if self._metrics:
+            # one request, k branch rollouts' worth of decode work
+            # (counted here, after the allocator check above)
+            self._metrics.served(1, k * horizon)
+            self._metrics.idle(self.num_pages)
         return np.asarray(out[:, :horizon], np.float32)

@@ -225,7 +225,6 @@ def bench_wire(native: bool) -> float:
             # a fresh checkout has no native/build; one make invocation
             # is cheap and keeps the whole artifact from depending on a
             # separate setup step
-            import os as _os
             import subprocess
 
             detail = ""
@@ -235,7 +234,7 @@ def bench_wire(native: bool) -> float:
                     capture_output=True,
                     text=True,
                     timeout=120,
-                    cwd=_os.path.dirname(_os.path.abspath(__file__)),
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
                 )
                 if built.returncode != 0:
                     tail = (built.stderr or "").strip().splitlines()[-1:]

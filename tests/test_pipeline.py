@@ -477,13 +477,17 @@ def test_1f1b_wall_clock_tracks_tick_count(pp_mesh):
         return best
 
     m_small, m_big = 2, 18
-    t_small, t_big = timed(m_small), timed(m_big)
     ticks = lambda m: m + 2 * (s - 1)
     expected = ticks(m_big) / ticks(m_small)            # 3.0
     serialized = (m_big * s) / (m_small * s)            # 9.0
-    ratio = t_big / t_small
     # generous CI headroom around 3.0, but far below the 9.0 a
-    # serialized schedule would produce
+    # serialized schedule would produce; one re-measure absorbs a
+    # transient load spike on a shared single-core host (observed: a
+    # concurrent test run pushed the ratio past the bound once)
+    for attempt in range(2):
+        ratio = timed(m_big) / timed(m_small)
+        if ratio < (expected + serialized) / 2:
+            break
     assert ratio < (expected + serialized) / 2, (
         f"1F1B runtime ratio {ratio:.2f} vs expected ~{expected:.1f} "
         f"(serialized would be ~{serialized:.1f})"

@@ -202,6 +202,95 @@ def test_run_waves_matches_run():
     assert not bool(b.state.active.any())
 
 
+def test_run_defers_admission_under_pool_pressure():
+    """run() must DEFER admissions when the pool cannot hold another
+    request's worst-case growth (the break in the batched admission
+    round): with need=3-page requests and a 4-page pool, only one can
+    be active at a time, so three requests serialize through two slots
+    — and every forecast still matches the dense rollout."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    # t=17, h=8 -> ceil((17 + 7) / 8) = 3 pages each; pool of 4 admits
+    # exactly one at a time
+    requests = [_request(i, t=17, horizon=8) for i in range(3)]
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=4, page_size=8, slots=2, max_prefix=32,
+        max_pages_per_seq=4,
+    )
+    results = batcher.run(requests)
+    for i, req in enumerate(requests):
+        want = np.asarray(
+            forecast_deltas(
+                model, state.params,
+                jnp.asarray(req.progress)[None],
+                jnp.asarray(req.statuses)[None], req.horizon,
+            )[0],
+            np.float32,
+        )
+        assert results[i].shape == want.shape
+        np.testing.assert_allclose(
+            results[i][:2], want[:2], rtol=3e-2, atol=1.5e-2,
+            err_msg=f"request {i}",
+        )
+    assert int(batcher.state.free_top) == 4
+    assert not bool(batcher.state.active.any())
+
+
+def test_serving_metrics_exported():
+    """With a registry passed, the batcher exports pool/slot gauges and
+    served-request/token counters (host-side arithmetic only) on the
+    same exposition the service serves; without one, the reference's
+    exposition stays byte-identical (no beholder_serving_* series)."""
+    from beholder_tpu.metrics import Metrics
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    assert "beholder_serving" not in Metrics().registry.render()
+
+    metrics = Metrics()
+    batcher = ContinuousBatcher(
+        model, state.params, num_pages=16, page_size=8, slots=2,
+        max_prefix=16, max_pages_per_seq=4, metrics=metrics,
+    )
+    batcher.run_waves([_request(i, t=9, horizon=4) for i in range(3)])
+    text = metrics.registry.render()
+    assert "beholder_serving_requests_total 3" in text
+    assert "beholder_serving_tokens_total 12" in text
+    assert "beholder_serving_pool_pages_free 16" in text  # drained back
+    assert "beholder_serving_slots_active 0" in text
+
+    # the per-event scheduler accumulates into the same series
+    batcher.run([_request(9, t=9, horizon=6)])
+    text = metrics.registry.render()
+    assert "beholder_serving_requests_total 4" in text
+    assert "beholder_serving_tokens_total 18" in text
+
+    # what-if forks count one request, k branches of decode work
+    batcher.run_what_if(
+        _request(3, t=9, horizon=1).progress,
+        _request(3, t=9, horizon=1).statuses,
+        [int(TelemetryStatusEntry.CONVERTING),
+         int(TelemetryStatusEntry.ERRORED)],
+        horizon=3,
+    )
+    text = metrics.registry.render()
+    assert "beholder_serving_requests_total 5" in text
+    assert "beholder_serving_tokens_total 24" in text
+
+    # a REPLACEMENT batcher (the documented recovery from pool
+    # exhaustion) re-attaches to the same series instead of tripping
+    # the registry's duplicate guard
+    b2 = ContinuousBatcher(
+        model, state.params, num_pages=16, page_size=8, slots=2,
+        max_prefix=16, max_pages_per_seq=4, metrics=metrics,
+    )
+    b2.run_waves([_request(11, t=9, horizon=2)])
+    text = metrics.registry.render()
+    assert "beholder_serving_requests_total 6" in text
+    assert "beholder_serving_tokens_total 26" in text
+
+
 def test_run_waves_defers_ride_along_table_overflow():
     """A short-horizon request riding a long-horizon wave member would
     outgrow its own page table (round-4 review finding): the scheduler
