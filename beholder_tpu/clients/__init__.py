@@ -7,7 +7,13 @@ All share a pluggable HTTP transport so tests can intercept traffic.
 """
 
 from .emby import EmbyClient
-from .http import HttpResponse, HttpTransport, RecordingTransport, RequestsTransport
+from .http import (
+    HttpResponse,
+    HttpTransport,
+    RecordingTransport,
+    RequestsTransport,
+    TimedTransport,
+)
 from .telegram import TelegramClient
 from .trello import TrelloClient
 
@@ -16,6 +22,7 @@ __all__ = [
     "HttpResponse",
     "RequestsTransport",
     "RecordingTransport",
+    "TimedTransport",
     "TrelloClient",
     "TelegramClient",
     "EmbyClient",

@@ -9,6 +9,7 @@ tests can assert on exact requests without network access.
 from __future__ import annotations
 
 import abc
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -58,6 +59,46 @@ class RequestsTransport(HttpTransport):
         except ValueError:
             body = resp.text
         return HttpResponse(status=resp.status_code, body=body)
+
+
+class TimedTransport(HttpTransport):
+    """Wraps any transport with a request-latency histogram
+    (``beholder_http_request_seconds{method,outcome}``). Extension
+    surface: nothing is registered unless one is constructed (the
+    service wires it behind ``instance.observability.enabled``), so the
+    reference exposition stays byte-identical by default. ``outcome``
+    is the status class (``2xx``/``4xx``/...) or ``error`` when the
+    transport raised before producing a response."""
+
+    def __init__(self, inner: HttpTransport, registry):
+        from beholder_tpu.metrics import get_or_create
+
+        self.inner = inner
+        self._hist = get_or_create(
+            getattr(registry, "registry", registry),
+            "histogram",
+            "beholder_http_request_seconds",
+            "Outbound HTTP request latency by method and outcome",
+            labelnames=["method", "outcome"],
+        )
+
+    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+        t0 = time.perf_counter()
+        try:
+            resp = self.inner.request(
+                method, url, params=params, json=json, timeout=timeout
+            )
+        except Exception:
+            self._hist.observe(
+                time.perf_counter() - t0, method=method.upper(),
+                outcome="error",
+            )
+            raise
+        self._hist.observe(
+            time.perf_counter() - t0, method=method.upper(),
+            outcome=f"{resp.status // 100}xx",
+        )
+        return resp
 
 
 @dataclass

@@ -1,0 +1,32 @@
+"""Version-compatibility shims for the jax API surface this repo spans.
+
+The CI image pins a newer jax than some dev hosts carry; two renames
+matter to this codebase:
+
+- ``jax.experimental.shard_map.shard_map`` was promoted to
+  ``jax.shard_map`` (and the experimental path later removed) — resolve
+  whichever exists once, here.
+- ``pltpu.TPUMemorySpace`` became ``pltpu.MemorySpace`` (handled in
+  :mod:`beholder_tpu.ops.paged_attention`, next to its only use).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pre-promotion jax: the experimental path
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):  # type: ignore[no-redef]
+        # the promotion also renamed check_rep -> check_vma; callers in
+        # this repo write the new spelling
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+__all__ = ["shard_map"]

@@ -15,8 +15,11 @@ byte-identical to index.js:32,37 (including the reference's "crreated" typo).
 
 from __future__ import annotations
 
+import bisect
+import json
 import os
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from typing import Iterable
 
@@ -25,16 +28,57 @@ from beholder_tpu.httpd import serve_routes
 DEFAULT_PORT = 8000
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+#: prom-client's default latency buckets (seconds), cumulative ``le``.
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
 
-class Counter:
-    """A monotonically increasing counter, optionally labelled."""
+
+class _Labelled:
+    """Shared label plumbing for the three metric types: name/help/
+    labelnames state, label validation, and classic-exposition label
+    rendering — one copy to keep ``{a="b"}`` escaping and error
+    messages from drifting between types."""
 
     def __init__(self, name: str, help: str, labelnames: Iterable[str] = ()):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._values: dict[tuple[str, ...], float] = {}
         self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[name]) for name in self.labelnames)
+
+    def _label_str(self, key: tuple[str, ...]) -> str:
+        return ",".join(
+            f'{name}="{_esc(val)}"' for name, val in zip(self.labelnames, key)
+        )
+
+    def _render_simple(self, kind: str, items) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {kind}",
+        ]
+        for key, value in items:
+            if key:
+                lines.append(
+                    f"{self.name}{{{self._label_str(key)}}} {_fmt(value)}"
+                )
+            else:
+                lines.append(f"{self.name} {_fmt(value)}")
+        return "\n".join(lines)
+
+
+class Counter(_Labelled):
+    """A monotonically increasing counter, optionally labelled."""
+
+    def __init__(self, name: str, help: str, labelnames: Iterable[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: dict[tuple[str, ...], float] = {}
         if not self.labelnames:
             self._values[()] = 0.0
 
@@ -43,47 +87,27 @@ class Counter:
             with self._lock:
                 self._values[()] += amount
             return
-        if set(labels) != set(self.labelnames):
-            raise ValueError(
-                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
-            )
-        key = tuple(str(labels[name]) for name in self.labelnames)
+        key = self._key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def labels(self, **labels: str) -> "_BoundCounter":
         """A bound child for one label combination (prom-client pattern);
         hot paths cache these to skip per-call label validation."""
-        if set(labels) != set(self.labelnames):
-            raise ValueError(
-                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
-            )
-        key = tuple(str(labels[name]) for name in self.labelnames)
+        key = self._key(labels)
         with self._lock:
             self._values.setdefault(key, 0.0)
         return _BoundCounter(self, key)
 
     def value(self, **labels: str) -> float:
-        key = tuple(str(labels[name]) for name in self.labelnames)
+        key = self._key(labels)
         with self._lock:
             return self._values.get(key, 0.0)
 
     def render(self) -> str:
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} counter",
-        ]
         with self._lock:
             items = sorted(self._values.items())
-        for key, value in items:
-            if key:
-                labels = ",".join(
-                    f'{name}="{val}"' for name, val in zip(self.labelnames, key)
-                )
-                lines.append(f"{self.name}{{{labels}}} {_fmt(value)}")
-            else:
-                lines.append(f"{self.name} {_fmt(value)}")
-        return "\n".join(lines)
+        return self._render_simple("counter", items)
 
 
 class _BoundCounter:
@@ -102,38 +126,210 @@ def _fmt(value: float) -> str:
     return str(int(value)) if value == int(value) else repr(value)
 
 
-class Gauge:
-    """A settable instantaneous value (classic ``# TYPE ... gauge``).
+def _esc(value: str) -> str:
+    """Prometheus label-value escaping: label values can be arbitrary
+    input (broker queue names arrive from clients via queue.declare), and
+    one unescaped quote would make the whole exposition unparseable."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+class Gauge(_Labelled):
+    """A settable instantaneous value (classic ``# TYPE ... gauge``),
+    optionally labelled.
 
     Extension surface — the reference exposes only the two counters, so
     gauges never appear in the default :class:`Metrics` set (its
     exposition stays byte-identical); they exist for extension
-    subsystems like the paged serving layer's pool instrumentation."""
+    subsystems like the paged serving layer's pool instrumentation and
+    the test broker's per-queue depth series."""
 
-    def __init__(self, name: str, help: str):
-        self.name = name
-        self.help = help
-        self._value = 0.0
-        self._lock = threading.Lock()
+    def __init__(self, name: str, help: str, labelnames: Iterable[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: dict[tuple[str, ...], float] = {}
+        if not self.labelnames:
+            self._values[()] = 0.0
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
         with self._lock:
-            self._value = float(value)
+            self._values[key] = float(value)
 
-    def value(self) -> float:
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
         with self._lock:
-            return self._value
+            return self._values.get(key, 0.0)
 
     def render(self) -> str:
         with self._lock:
-            value = self._value
-        return "\n".join(
-            [
-                f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} gauge",
-                f"{self.name} {_fmt(value)}",
-            ]
+            items = sorted(self._values.items())
+        return self._render_simple("gauge", items)
+
+
+class Histogram(_Labelled):
+    """Classic-exposition latency histogram: cumulative ``le`` buckets
+    (``_bucket`` lines), ``_sum`` and ``_count`` series, optionally
+    labelled. Observations are seconds by convention (prom-client's).
+
+    Extension surface like :class:`Gauge` — histograms never appear in
+    the default :class:`Metrics` set, so the reference exposition stays
+    byte-identical; the serving scheduler, broker, storage server, and
+    HTTP transport register theirs explicitly.
+
+    Every ``observe()`` also feeds the module's optional observation
+    log (:func:`configure_observation_log`): one JSON line per raw
+    observation, stamped with the active trace id when the observation
+    happens inside a :class:`~beholder_tpu.tracing.Span` context — the
+    cross-link that lets a latency outlier be looked up as a trace.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        # per label key: [per-bucket counts..., +Inf overflow count]
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        if not self.labelnames:
+            self._counts[()] = [0] * (len(self.buckets) + 1)
+            self._sums[()] = 0.0
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+            counts[idx] += 1
+            self._sums[key] += value
+        _observation_record(self.name, value, dict(labels))
+
+    def time(self, **labels: str) -> "_HistogramTimer":
+        """Context manager observing the block's wall time in seconds."""
+        return _HistogramTimer(self, labels)
+
+    def count(self, **labels: str) -> int:
+        key = self._key(labels)
+        with self._lock:
+            return sum(self._counts.get(key, ()))
+
+    def sum(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._sums.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            items = sorted(
+                (key, list(counts), self._sums[key])
+                for key, counts in self._counts.items()
+            )
+        for key, counts, total_sum in items:
+            prefix = self._label_str(key)
+            cumulative = 0
+            for bound, count in zip(self.buckets, counts):
+                cumulative += count
+                labels = (prefix + "," if prefix else "") + f'le="{_fmt(bound)}"'
+                lines.append(f"{self.name}_bucket{{{labels}}} {cumulative}")
+            cumulative += counts[-1]
+            labels = (prefix + "," if prefix else "") + 'le="+Inf"'
+            lines.append(f"{self.name}_bucket{{{labels}}} {cumulative}")
+            suffix = f"{{{prefix}}}" if prefix else ""
+            lines.append(f"{self.name}_sum{suffix} {_fmt(total_sum)}")
+            lines.append(f"{self.name}_count{suffix} {cumulative}")
+        return "\n".join(lines)
+
+
+class _HistogramTimer:
+    __slots__ = ("_histogram", "_labels", "_t0")
+
+    def __init__(self, histogram: Histogram, labels: dict):
+        self._histogram = histogram
+        self._labels = labels
+
+    def __enter__(self) -> "_HistogramTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self._histogram.observe(
+            time.perf_counter() - self._t0, **self._labels
         )
+
+
+# -- observation log ---------------------------------------------------------
+#
+# Exposition aggregates; this side channel keeps the RAW observations: one
+# JSON line each, carrying the active trace id so a latency outlier on a
+# histogram can be cross-linked to the span that produced it ($TRACE_JSONL's
+# metrics-side twin). Off unless configured (or $METRICS_OBS_JSONL is set).
+
+_obs_lock = threading.Lock()
+_obs_path: str | None = None
+#: cached append handle (+ the path it is open on): serving rounds emit
+#: sub-ms observations, so an open()/close() syscall pair per observe()
+#: would cost as much as the work being measured
+_obs_file = None
+_obs_file_path: str | None = None
+
+
+def configure_observation_log(path: str | None) -> None:
+    """Append raw histogram observations to ``path`` as JSON lines
+    (``None`` reverts to the $METRICS_OBS_JSONL env var / disabled)."""
+    global _obs_path, _obs_file, _obs_file_path
+    with _obs_lock:
+        _obs_path = path
+        if _obs_file is not None:
+            try:
+                _obs_file.close()
+            except Exception:  # noqa: BLE001
+                pass
+        _obs_file = None
+        _obs_file_path = None
+
+
+def _observation_record(metric: str, value: float, labels: dict) -> None:
+    global _obs_file, _obs_file_path
+    path = _obs_path or os.environ.get("METRICS_OBS_JSONL")
+    if not path:
+        return
+    try:
+        from beholder_tpu.tracing import current_trace_id
+
+        line = json.dumps(
+            {
+                "ts_us": int(time.time() * 1e6),
+                "metric": metric,
+                "value": value,
+                "labels": labels,
+                "trace_id": current_trace_id(),
+            }
+        )
+        with _obs_lock:
+            if _obs_file is None or _obs_file_path != path:
+                if _obs_file is not None:
+                    _obs_file.close()
+                _obs_file = open(path, "a")
+                _obs_file_path = path
+            _obs_file.write(line + "\n")
+            _obs_file.flush()
+    except Exception:  # noqa: BLE001 - a broken sink must not kill hot paths
+        pass
 
 
 class Registry:
@@ -162,13 +358,43 @@ class Registry:
     def counter(self, name: str, help: str, labelnames: Iterable[str] = ()) -> Counter:
         return self._register(Counter(name, help, labelnames))
 
-    def gauge(self, name: str, help: str) -> Gauge:
-        return self._register(Gauge(name, help))
+    def gauge(self, name: str, help: str, labelnames: Iterable[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help, labelnames))
+
+    def histogram(
+        self,
+        name: str,
+        help: str,
+        labelnames: Iterable[str] = (),
+        buckets: Iterable[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(Histogram(name, help, labelnames, buckets))
 
     def render(self) -> str:
         with self._lock:
             metrics = list(self._metrics)
         return "\n".join(m.render() for m in metrics) + "\n"
+
+
+_METRIC_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+def get_or_create(registry: Registry, kind: str, name: str, help: str, **kwargs):
+    """Find-or-register one metric: a re-created component (e.g. a fresh
+    ContinuousBatcher after a pool-exhaustion error, or a restarted test
+    broker) re-attaches to its existing series instead of tripping the
+    duplicate guard. A name already registered as a DIFFERENT kind is a
+    wiring bug and raises here, not an AttributeError mid-hot-path."""
+    found = registry.find(name)
+    if found is not None:
+        want = _METRIC_KINDS[kind]
+        if not isinstance(found, want):
+            raise ValueError(
+                f"metric {name!r} is already registered as a "
+                f"{type(found).__name__}, not a {want.__name__}"
+            )
+        return found
+    return getattr(registry, kind)(name, help, **kwargs)
 
 
 class Metrics:

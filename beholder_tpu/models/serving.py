@@ -63,6 +63,8 @@ rollout (pinned by ``tests/test_serving.py``).
 
 from __future__ import annotations
 
+import time
+from contextlib import contextmanager, nullcontext
 from typing import NamedTuple
 
 import jax
@@ -721,40 +723,102 @@ class _ServingMetrics:
     scheduler's host-side bookkeeping: instrumentation adds ZERO device
     reads (the whole round-5 serving story)."""
 
+    #: scheduling rounds span sub-ms tick dispatches to the ~65 ms
+    #: tunnel readback constant; default prom buckets start too high
+    ROUND_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5,
+    )
+    RUN_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+    TOKEN_BUCKETS = (
+        1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+        1e-2, 0.1,
+    )
+
     def __init__(self, registry, num_pages: int):
-        def get_or_create(kind, name, help):
-            # a REPLACEMENT batcher (the documented recovery from a
-            # pool-exhaustion error) re-attaches to the service's
-            # existing series instead of tripping the duplicate guard
-            return registry.find(name) or getattr(registry, kind)(
-                name, help
-            )
+        # get_or_create: a REPLACEMENT batcher (the documented recovery
+        # from a pool-exhaustion error) re-attaches to the service's
+        # existing series instead of tripping the duplicate guard; a
+        # name held by a DIFFERENT metric kind raises ValueError here
+        # rather than AttributeError mid-run
+        from beholder_tpu.metrics import get_or_create
 
         self.pool_pages_free = get_or_create(
-            "gauge",
+            registry, "gauge",
             "beholder_serving_pool_pages_free",
             "KV pages not reserved by any in-flight request",
         )
         self.slots_active = get_or_create(
-            "gauge",
+            registry, "gauge",
             "beholder_serving_slots_active",
             "Serving slots holding an in-flight request",
         )
         self.requests_total = get_or_create(
-            "counter",
+            registry, "counter",
             "beholder_serving_requests_total",
             "Requests fully served by the paged serving layer",
         )
         self.tokens_total = get_or_create(
-            "counter",
+            registry, "counter",
             "beholder_serving_tokens_total",
             "Forecast tokens decoded by the paged serving layer",
+        )
+        # device_results mode returns UNCHECKED device arrays (the
+        # caller owns the alloc_failed check), so its work counts as
+        # dispatched, never served — a tripped allocator can no longer
+        # permanently overcount the served series
+        self.requests_dispatched_total = get_or_create(
+            registry, "counter",
+            "beholder_serving_requests_dispatched_total",
+            "Requests dispatched in device_results mode (unverified by "
+            "the end-of-run allocator check)",
+        )
+        self.tokens_dispatched_total = get_or_create(
+            registry, "counter",
+            "beholder_serving_tokens_dispatched_total",
+            "Forecast tokens dispatched in device_results mode "
+            "(unverified by the end-of-run allocator check)",
+        )
+        self.round_seconds = get_or_create(
+            registry, "histogram",
+            "beholder_serving_round_duration_seconds",
+            "Wall time of one scheduling round by phase "
+            "(admit/tick/retire/wave/readback)",
+            labelnames=["phase"],
+            buckets=self.ROUND_BUCKETS,
+        )
+        self.run_seconds = get_or_create(
+            registry, "histogram",
+            "beholder_serving_run_duration_seconds",
+            "End-to-end scheduler call wall time by mode",
+            labelnames=["mode"],
+            buckets=self.RUN_BUCKETS,
+        )
+        self.token_seconds = get_or_create(
+            registry, "histogram",
+            "beholder_serving_token_latency_seconds",
+            "Per-token wall time of one scheduler call (run wall time / "
+            "forecast tokens produced)",
+            labelnames=["mode"],
+            buckets=self.TOKEN_BUCKETS,
         )
         self.pool_pages_free.set(num_pages)
 
     def served(self, n_requests: int, n_tokens: int) -> None:
         self.requests_total.inc(n_requests)
         self.tokens_total.inc(n_tokens)
+
+    def dispatched(self, n_requests: int, n_tokens: int) -> None:
+        self.requests_dispatched_total.inc(n_requests)
+        self.tokens_dispatched_total.inc(n_tokens)
+
+    def observe_round(self, phase: str, seconds: float) -> None:
+        self.round_seconds.observe(seconds, phase=phase)
+
+    def observe_run(self, mode: str, seconds: float, n_tokens: int) -> None:
+        self.run_seconds.observe(seconds, mode=mode)
+        if n_tokens > 0:
+            self.token_seconds.observe(seconds / n_tokens, mode=mode)
 
     def idle(self, num_pages: int) -> None:
         self.slots_active.set(0)
@@ -782,12 +846,20 @@ class ContinuousBatcher:
 
     ``metrics`` (a :class:`beholder_tpu.metrics.Registry`, or a
     :class:`~beholder_tpu.metrics.Metrics` whose registry is used)
-    exports the scheduler's pool/slot occupancy as prometheus gauges
-    plus served-request/token counters alongside the service's own
-    series — the serving layer's telemetry rides the same /metrics
-    endpoint the reference exposes. Purely host-side (zero device
-    reads); omitted, nothing is registered and the reference exposition
-    stays byte-identical.
+    exports the scheduler's pool/slot occupancy as prometheus gauges,
+    served/dispatched request+token counters, and latency histograms
+    (per-round by phase, per-run by mode, per-token) alongside the
+    service's own series — the serving layer's telemetry rides the same
+    /metrics endpoint the reference exposes. Purely host-side (zero
+    device reads); omitted, nothing is registered and the reference
+    exposition stays byte-identical.
+
+    ``tracer`` (a :class:`beholder_tpu.tracing.Tracer`) opens one span
+    per scheduler call (``serving.run`` / ``serving.run_waves`` /
+    ``serving.what_if``) with one child span per scheduling round
+    (admit/tick/retire/wave/readback); histogram observations made
+    inside those spans carry the trace id in the metrics observation
+    log, so a latency outlier cross-links to its serving timeline.
     """
 
     def __init__(
@@ -802,6 +874,7 @@ class ContinuousBatcher:
         max_pages_per_seq: int = 32,
         cache_dtype=jnp.bfloat16,
         metrics=None,
+        tracer=None,
     ):
         self.model = model
         self.params = params
@@ -821,6 +894,7 @@ class ContinuousBatcher:
             if metrics is not None
             else None
         )
+        self._tracer = tracer
         self._release_many = jax.jit(paged_release_many)
         self._tick_carry = jax.jit(
             lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
@@ -892,6 +966,33 @@ class ContinuousBatcher:
                 f"the horizon"
             )
 
+    def _run_span(self, operation: str, **tags):
+        """Root span for one scheduler call (``with``-able; nullcontext
+        when no tracer is wired, so the bare path costs nothing)."""
+        if self._tracer is None:
+            return nullcontext()
+        return self._tracer.start_span(operation, tags=tags)
+
+    @contextmanager
+    def _round(self, parent, phase: str, **tags):
+        """One scheduling round: a child span under the run span plus a
+        ``round_duration_seconds{phase=...}`` observation. Host-side
+        clocks only — instrumentation adds zero device reads."""
+        t0 = time.perf_counter()
+        cm = (
+            self._tracer.start_span(
+                f"serving.{phase}", child_of=parent, tags=tags
+            )
+            if self._tracer is not None and parent is not None
+            else nullcontext()
+        )
+        try:
+            with cm:
+                yield
+        finally:
+            if self._metrics is not None:
+                self._metrics.observe_round(phase, time.perf_counter() - t0)
+
     def _start_run(self, requests: list[Request]):
         """Fail fast BEFORE anything is admitted: every per-request
         precondition (prefix cap, pool/table fit) is checked up front so
@@ -934,13 +1035,24 @@ class ContinuousBatcher:
         by side in ``bench.py`` (``serving.run_value`` vs
         ``serving.value``)."""
         self._start_run(requests)
+        t0 = time.perf_counter()
         try:
-            return self._run(requests)
+            with self._run_span(
+                "serving.run", requests=len(requests)
+            ) as span:
+                results = self._run(requests, span)
         except BaseException:
             self._poisoned = True
             raise
+        if self._metrics:
+            self._metrics.observe_run(
+                "run",
+                time.perf_counter() - t0,
+                sum(max(r.horizon, 0) for r in requests),
+            )
+        return results
 
-    def _run(self, requests: list[Request]) -> list[np.ndarray]:
+    def _run(self, requests: list[Request], span=None) -> list[np.ndarray]:
         queue = list(enumerate(requests))
         results: list = [None] * len(requests)
         cap = max(
@@ -980,21 +1092,22 @@ class ContinuousBatcher:
             crosses to the host — full (cap,) rows are gathered so every
             event's snapshot has a packable shape, with the live widths
             riding along host-side for the post-fetch trim."""
-            idx = jnp.asarray(done, jnp.int32)
-            rids = [req_of[s] for s in done]
-            snap_batches.append((
-                rids,
-                carry.delta_buf[idx],
-                carry.last_pred[idx],
-                [int(written[s]) for s in done],
-            ))
-            self.state = self._release_many(self.state, idx)
-            for s in done:
-                req_of[s] = None
-                total_need[s] = 0
-                written[s] = 0
-            served[0] += len(done)
-            served[1] += sum(requests[r].horizon for r in rids)
+            with self._round(span, "retire", slots=len(done)):
+                idx = jnp.asarray(done, jnp.int32)
+                rids = [req_of[s] for s in done]
+                snap_batches.append((
+                    rids,
+                    carry.delta_buf[idx],
+                    carry.last_pred[idx],
+                    [int(written[s]) for s in done],
+                ))
+                self.state = self._release_many(self.state, idx)
+                for s in done:
+                    req_of[s] = None
+                    total_need[s] = 0
+                    written[s] = 0
+                served[0] += len(done)
+                served[1] += sum(requests[r].horizon for r in rids)
 
         while queue or any(r is not None for r in req_of):
             # admission round: claim every (slot, request) pair that fits
@@ -1031,27 +1144,28 @@ class ContinuousBatcher:
                 total_need[slot] = need
                 written[slot] = 0
             if batch:
-                t_pad = -(
-                    -max(t for _, _, _, t in batch) // self.page_size
-                ) * self.page_size
-                admit = self._cached_jit(
-                    ("admit", len(batch), t_pad),
-                    lambda: lambda p, s, c, ids, f, ln, st: (
-                        _admit_many_carry(self.model, p, s, c, ids, f, ln, st)
-                    ),
-                )
-                self.state, carry = admit(
-                    self.params, self.state, carry,
-                    jnp.asarray([s for s, _, _, _ in batch], jnp.int32),
-                    jnp.asarray(np.stack(
-                        [self._pad_to(f, t_pad) for _, _, f, _ in batch]
-                    )),
-                    jnp.asarray([t for _, _, _, t in batch], jnp.int32),
-                    jnp.asarray(
-                        [int(requests[r].statuses[-1]) for _, r, _, _ in batch],
-                        jnp.int32,
-                    ),
-                )
+                with self._round(span, "admit", requests=len(batch)):
+                    t_pad = -(
+                        -max(t for _, _, _, t in batch) // self.page_size
+                    ) * self.page_size
+                    admit = self._cached_jit(
+                        ("admit", len(batch), t_pad),
+                        lambda: lambda p, s, c, ids, f, ln, st: (
+                            _admit_many_carry(self.model, p, s, c, ids, f, ln, st)
+                        ),
+                    )
+                    self.state, carry = admit(
+                        self.params, self.state, carry,
+                        jnp.asarray([s for s, _, _, _ in batch], jnp.int32),
+                        jnp.asarray(np.stack(
+                            [self._pad_to(f, t_pad) for _, _, f, _ in batch]
+                        )),
+                        jnp.asarray([t for _, _, _, t in batch], jnp.int32),
+                        jnp.asarray(
+                            [int(requests[r].statuses[-1]) for _, r, _, _ in batch],
+                            jnp.int32,
+                        ),
+                    )
                 done = [s for s, _, _, _ in batch if remaining[s] == 1]
                 if done:
                     retire_many(done)  # admit predictions WERE the forecasts
@@ -1075,10 +1189,11 @@ class ContinuousBatcher:
                            if active[s])) - 1
             )
             write_idx = np.where(active, written, cap).astype(np.int32)
-            self.state, carry = self._tick_chunk(
-                self.params, self.state, carry, jnp.asarray(write_idx),
-                jnp.int32(n_chunk),
-            )
+            with self._round(span, "tick", ticks=n_chunk):
+                self.state, carry = self._tick_chunk(
+                    self.params, self.state, carry, jnp.asarray(write_idx),
+                    jnp.int32(n_chunk),
+                )
             done = []
             for slot in range(self.slots):
                 if req_of[slot] is None:
@@ -1102,16 +1217,17 @@ class ContinuousBatcher:
         # tails, and rows are packed into a single flat device array
         # first (a few ~20 us dispatches) and fetched in one crossing
         if snap_batches:
-            rows = jnp.concatenate([b[1] for b in snap_batches])
-            tails = jnp.concatenate([b[2] for b in snap_batches])
-            packed = jnp.concatenate(
-                [
-                    self.state.alloc_failed.astype(jnp.float32)[None],
-                    tails.astype(jnp.float32),
-                    rows.reshape(-1),
-                ]
-            )
-            got = np.asarray(jax.device_get(packed), np.float32)
+            with self._round(span, "readback", batches=len(snap_batches)):
+                rows = jnp.concatenate([b[1] for b in snap_batches])
+                tails = jnp.concatenate([b[2] for b in snap_batches])
+                packed = jnp.concatenate(
+                    [
+                        self.state.alloc_failed.astype(jnp.float32)[None],
+                        tails.astype(jnp.float32),
+                        rows.reshape(-1),
+                    ]
+                )
+                got = np.asarray(jax.device_get(packed), np.float32)
             if got[0]:
                 raise RuntimeError(self._ALLOCATOR_TRIPPED)
             rids = [rid for b in snap_batches for rid in b[0]]
@@ -1164,14 +1280,27 @@ class ContinuousBatcher:
         benchmarking mode; the caller owns checking
         ``state.alloc_failed`` before trusting them."""
         self._start_run(requests)
+        t0 = time.perf_counter()
         try:
-            return self._run_waves(requests, device_results)
+            with self._run_span(
+                "serving.run_waves",
+                requests=len(requests),
+                device_results=device_results,
+            ) as span:
+                results = self._run_waves(requests, device_results, span)
         except BaseException:
             self._poisoned = True
             raise
+        if self._metrics:
+            self._metrics.observe_run(
+                "run_waves",
+                time.perf_counter() - t0,
+                sum(max(r.horizon, 0) for r in requests),
+            )
+        return results
 
     def _run_waves(
-        self, requests: list[Request], device_results: bool
+        self, requests: list[Request], device_results: bool, span=None
     ) -> list:
         results: list = [None] * len(requests)
         queue = list(enumerate(requests))
@@ -1219,27 +1348,30 @@ class ContinuousBatcher:
             if not wave:
                 continue
 
-            prepped = [self._prep_np(req) for _, req in wave]
-            t_pad = -(
-                -max(t for _, t in prepped) // self.page_size
-            ) * self.page_size
-            feats = np.stack([self._pad_to(p, t_pad) for p, _ in prepped])
-            lens = np.asarray([t for _, t in prepped], np.int32)
-            stats = np.asarray(
-                [int(req.statuses[-1]) for _, req in wave], np.int32
-            )
-            horizons = (
-                tuple(req.horizon for _, req in wave)
-                if device_results
-                else None
-            )
-            deltas, self.state = self._serve_fn(
-                len(wave), horizon - 1, horizons
-            )(
-                self.params, self.state, jnp.asarray(feats),
-                jnp.asarray(lens), jnp.asarray(stats),
-            )
-            batches.append((wave, deltas))
+            with self._round(
+                span, "wave", requests=len(wave), horizon=horizon
+            ):
+                prepped = [self._prep_np(req) for _, req in wave]
+                t_pad = -(
+                    -max(t for _, t in prepped) // self.page_size
+                ) * self.page_size
+                feats = np.stack([self._pad_to(p, t_pad) for p, _ in prepped])
+                lens = np.asarray([t for _, t in prepped], np.int32)
+                stats = np.asarray(
+                    [int(req.statuses[-1]) for _, req in wave], np.int32
+                )
+                horizons = (
+                    tuple(req.horizon for _, req in wave)
+                    if device_results
+                    else None
+                )
+                deltas, self.state = self._serve_fn(
+                    len(wave), horizon - 1, horizons
+                )(
+                    self.params, self.state, jnp.asarray(feats),
+                    jnp.asarray(lens), jnp.asarray(stats),
+                )
+                batches.append((wave, deltas))
             if self._metrics:
                 # the most recently DISPATCHED wave's occupancy (dispatch
                 # is async; the device drains waves behind the loop).
@@ -1257,19 +1389,21 @@ class ContinuousBatcher:
         if device_results:
             # each wave's deltas is already a tuple of per-request
             # in-program-trimmed arrays — no eager slicing here. The
-            # caller owns the alloc_failed check in this mode, so the
-            # served counters count DISPATCHED work here
+            # caller owns the alloc_failed check in this mode, so this
+            # work counts on the DISPATCHED counters only; the served
+            # series stays reserved for allocator-checked results
             if self._metrics:
-                self._metrics.served(n_served, t_served)
+                self._metrics.dispatched(n_served, t_served)
             for wave, rows in batches:
                 for (rid, _), row in zip(wave, rows):
                     results[rid] = row
             return results
 
         # ONE host readback for all waves' results + the allocator flag
-        fetched = jax.device_get(
-            [d for _, d in batches] + [self.state.alloc_failed]
-        )
+        with self._round(span, "readback", batches=len(batches)):
+            fetched = jax.device_get(
+                [d for _, d in batches] + [self.state.alloc_failed]
+            )
         if fetched[-1]:
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
         if self._metrics:
@@ -1345,23 +1479,29 @@ class ContinuousBatcher:
                 self.model, p, s, f, ln, br, n_ticks
             ),
         )
+        t0 = time.perf_counter()
         try:
-            deltas, self.state = fn(
-                self.params, self.state,
-                jnp.asarray(self._pad_to(feats_np, t_pad))[None],
-                jnp.int32(t),
-                jnp.asarray(branch_statuses, jnp.int32),
-            )
-            # flag + deltas packed into ONE buffer before the fetch —
-            # the tunnel charges its ~65 ms d2h constant per BUFFER
-            # (same packing as run()'s final readback)
-            packed = jnp.concatenate(
-                [
-                    self.state.alloc_failed.astype(jnp.float32)[None],
-                    deltas.astype(jnp.float32).reshape(-1),
-                ]
-            )
-            got = np.asarray(jax.device_get(packed), np.float32)
+            with self._run_span(
+                "serving.what_if", branches=k, horizon=horizon
+            ) as span:
+                with self._round(span, "wave", requests=1, horizon=horizon):
+                    deltas, self.state = fn(
+                        self.params, self.state,
+                        jnp.asarray(self._pad_to(feats_np, t_pad))[None],
+                        jnp.int32(t),
+                        jnp.asarray(branch_statuses, jnp.int32),
+                    )
+                # flag + deltas packed into ONE buffer before the fetch —
+                # the tunnel charges its ~65 ms d2h constant per BUFFER
+                # (same packing as run()'s final readback)
+                with self._round(span, "readback", batches=1):
+                    packed = jnp.concatenate(
+                        [
+                            self.state.alloc_failed.astype(jnp.float32)[None],
+                            deltas.astype(jnp.float32).reshape(-1),
+                        ]
+                    )
+                    got = np.asarray(jax.device_get(packed), np.float32)
         except BaseException:
             self._poisoned = True
             raise
@@ -1374,4 +1514,7 @@ class ContinuousBatcher:
             # (counted here, after the allocator check above)
             self._metrics.served(1, k * horizon)
             self._metrics.idle(self.num_pages)
+            self._metrics.observe_run(
+                "what_if", time.perf_counter() - t0, k * horizon
+            )
         return np.asarray(out[:, :horizon], np.float32)

@@ -25,6 +25,60 @@ from beholder_tpu.log import get_logger
 
 from . import codec
 
+#: (class, method) -> spec name, for the per-method frame counter labels
+_METHOD_NAMES = {
+    codec.CONNECTION_START_OK: "connection.start-ok",
+    codec.CONNECTION_TUNE_OK: "connection.tune-ok",
+    codec.CONNECTION_OPEN: "connection.open",
+    codec.CONNECTION_CLOSE: "connection.close",
+    codec.CONNECTION_CLOSE_OK: "connection.close-ok",
+    codec.CHANNEL_OPEN: "channel.open",
+    codec.BASIC_QOS: "basic.qos",
+    codec.QUEUE_DECLARE: "queue.declare",
+    codec.BASIC_CONSUME: "basic.consume",
+    codec.BASIC_PUBLISH: "basic.publish",
+    codec.BASIC_ACK: "basic.ack",
+    codec.BASIC_NACK: "basic.nack",
+}
+
+
+class _BrokerMetrics:
+    """Prometheus instrumentation for the broker (extension surface:
+    registered only when a registry is handed to
+    :class:`AmqpTestServer`, so the reference exposition stays
+    byte-identical). Per-method frame counters show the wire traffic
+    mix; per-queue depth gauges show backlog building behind slow
+    consumers."""
+
+    def __init__(self, registry):
+        from beholder_tpu.metrics import get_or_create
+
+        self.frames_total = get_or_create(
+            registry, "counter",
+            "beholder_mq_frames_total",
+            "AMQP method frames handled by the broker, by method",
+            labelnames=["method"],
+        )
+        self.queue_depth = get_or_create(
+            registry, "gauge",
+            "beholder_mq_queue_depth",
+            "Messages waiting in a broker queue (excludes unacked "
+            "in-flight deliveries)",
+            labelnames=["queue"],
+        )
+        self._bound: dict = {}  # method cm -> bound counter child
+
+    def count_method(self, cm) -> None:
+        bound = self._bound.get(cm)
+        if bound is None:
+            name = _METHOD_NAMES.get(cm, f"unknown.{cm[0]}-{cm[1]}")
+            bound = self._bound[cm] = self.frames_total.labels(method=name)
+        bound.inc()
+
+    def set_depths(self, queues: dict[str, deque]) -> None:
+        for queue, pending in queues.items():
+            self.queue_depth.set(len(pending), queue=queue)
+
 
 class _Conn(asyncio.Protocol):
     def __init__(self, server: "AmqpTestServer"):
@@ -112,6 +166,8 @@ class _Conn(asyncio.Protocol):
 
     def _on_method(self, frame: codec.Frame) -> None:
         cm, reader = codec.parse_method(frame)
+        if self.server._metrics is not None:
+            self.server._metrics.count_method(cm)
         if cm == codec.CONNECTION_START_OK:
             reader.table()  # client properties
             mechanism = reader.shortstr()
@@ -281,12 +337,19 @@ class AmqpTestServer:
         port: int = 0,
         heartbeat: int = 30,
         send_heartbeats: bool = True,
+        metrics=None,
     ):
         self.user = user
         self.password = password
         self.heartbeat = heartbeat
         #: set False to simulate a silently-dead broker (watchdog tests)
         self.send_heartbeats = send_heartbeats
+        #: optional Registry (or Metrics) for frame/queue-depth series
+        self._metrics = (
+            _BrokerMetrics(getattr(metrics, "registry", metrics))
+            if metrics is not None
+            else None
+        )
         self._requested_port = port
         self.queues: dict[str, deque] = {}
         self.consumers: dict[str, list[_Conn]] = {}
@@ -369,6 +432,11 @@ class AmqpTestServer:
                 self._rr[queue] = idx + 1
                 consumers[idx].deliver(queue, body, redelivered, headers)
                 consumers = [c for c in consumers if c.can_take()]
+        # pump() runs after every queue mutation (publish, ack, nack,
+        # consume, connection loss), so refreshing the gauges here keeps
+        # them current without a second bookkeeping path
+        if self._metrics is not None:
+            self._metrics.set_depths(self.queues)
 
 
 def main() -> None:  # pragma: no cover - dev tool

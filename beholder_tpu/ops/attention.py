@@ -29,6 +29,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from beholder_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _NEG_INF = -1e30
@@ -487,7 +489,7 @@ def _ring_vjp(
     lse_spec = P(*lead, axis)
 
     def shard(fn, in_specs, out_specs):
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=False,
         )
@@ -614,7 +616,7 @@ def ulysses_attention(
         return jax.lax.all_to_all(att, axis, split_axis=2, concat_axis=1, tiled=True)
 
     spec = P(*_lead_axes(mesh, 4), axis, None)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local,
         mesh=mesh,
         in_specs=(spec, spec, spec),

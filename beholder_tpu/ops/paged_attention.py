@@ -103,6 +103,13 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+# jax renamed pltpu.TPUMemorySpace -> pltpu.MemorySpace around 0.5; the
+# members (ANY/VMEM/SMEM) are identical — accept either so the kernel
+# keeps working across the versions this repo meets (the CI image pins a
+# newer jax than some dev hosts carry)
+_MEMORY_SPACE = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+
+
 def _paged_kernel(
     table_ref, lens_ref, q_ref, kp_ref, vp_ref, ks_ref, vs_ref, o_ref,
     kbuf, vbuf, ksbuf, vsbuf, m_ref, l_ref, acc_ref, sems, *, page,
@@ -314,9 +321,9 @@ def _paged_call(
     quant = k_scale is not None
     scale = float(1.0 / (dh**0.5))
 
-    smem = pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM)
-    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)
-    vmem = pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM)
+    smem = pl.BlockSpec(memory_space=_MEMORY_SPACE.SMEM)
+    hbm = pl.BlockSpec(memory_space=_MEMORY_SPACE.ANY)
+    vmem = pl.BlockSpec(memory_space=_MEMORY_SPACE.VMEM)
 
     scratch = [
         pltpu.VMEM((2, slots, hkv, dh, page), k_pool.dtype),  # kbuf

@@ -26,6 +26,8 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+
+from beholder_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .sharding import leading_axis_spec, shardings_from_specs
@@ -113,7 +115,7 @@ def pipeline_forward(
         keep = jnp.where(idx == s - 1, jnp.ones((), done.dtype), 0)
         return jax.lax.psum(done * keep, axis)
 
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(stage_specs(stacked_params, axis), P()),
@@ -297,7 +299,7 @@ def pipeline_train_step(
         else stage_specs(stacked_params, axis)
     )
     data_spec = P(None, dp_axis) if dp_axis is not None else P()
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(p_specs, data_spec, data_spec),

@@ -17,6 +17,8 @@ preserved exactly:
 
 from __future__ import annotations
 
+import time
+
 from beholder_tpu import proto
 from beholder_tpu.clients import (
     EmbyClient,
@@ -50,6 +52,30 @@ class BeholderService:
         self.db = db
         self.metrics = metrics or Metrics()
         self.logger = logger or get_logger("beholder")
+
+        #: optional deep observability (extension; off by default so the
+        #: reference exposition stays byte-identical): per-message handle
+        #: histograms on the consumers and outbound HTTP latency via
+        #: TimedTransport, all riding the same /metrics endpoint
+        self._observability = bool(config.get("instance.observability.enabled"))
+        self.handle_seconds = None
+        if self._observability:
+            from beholder_tpu.clients.http import (
+                RequestsTransport,
+                TimedTransport,
+            )
+            from beholder_tpu.metrics import get_or_create
+
+            self.handle_seconds = get_or_create(
+                self.metrics.registry,
+                "histogram",
+                "beholder_message_handle_seconds",
+                "Telemetry message handle wall time by topic and outcome",
+                labelnames=["topic", "outcome"],
+            )
+            transport = TimedTransport(
+                transport or RequestsTransport(), self.metrics.registry
+            )
 
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),
@@ -117,6 +143,11 @@ class BeholderService:
         """Register both consumers (index.js:62,127) and log 'initialized'."""
         self.broker.connect()
         status, progress = self.handle_status, self.handle_progress
+        if self.handle_seconds is not None:
+            # timing INSIDE tracing: observations then carry the active
+            # consumer span's trace id in the metrics observation log
+            status = self._timed(STATUS_TOPIC, status)
+            progress = self._timed(PROGRESS_TOPIC, progress)
         if self.tracer is not None:
             # wrap at registration time so the disabled path (the default,
             # and the reference's behavior) pays zero per-message cost
@@ -125,6 +156,26 @@ class BeholderService:
         self.broker.listen(STATUS_TOPIC, status)
         self.broker.listen(PROGRESS_TOPIC, progress)
         self.logger.info("initialized")
+
+    def _timed(self, topic: str, handler):
+        """Observe per-message handle wall time into
+        ``beholder_message_handle_seconds{topic, outcome}``; an escaping
+        exception (the status consumer's unacked-failure path) counts as
+        ``outcome="error"`` and still propagates."""
+        hist = self.handle_seconds
+
+        def timed_handler(delivery: Delivery) -> None:
+            t0 = time.perf_counter()
+            try:
+                handler(delivery)
+            except Exception:
+                hist.observe(
+                    time.perf_counter() - t0, topic=topic, outcome="error"
+                )
+                raise
+            hist.observe(time.perf_counter() - t0, topic=topic, outcome="ok")
+
+        return timed_handler
 
     def _traced(self, operation: str, handler):
         """Run ``handler`` inside a consumer span; joins the producer's

@@ -23,8 +23,42 @@ import re
 import socketserver
 import struct
 import threading
+import time
 
 SCRAM_ITERATIONS = 4096
+
+
+class _PgMetrics:
+    """Prometheus instrumentation for the test server (extension
+    surface: registered only when a registry is handed to
+    :class:`PgTestServer`, so the reference exposition stays
+    byte-identical). Query timings are labelled by statement kind,
+    auth timings by outcome — SCRAM's 4096 PBKDF2 iterations make
+    auth a visible slice of short-lived-connection workloads."""
+
+    #: sub-ms dict lookups up to PBKDF2-bound auth handshakes
+    BUCKETS = (
+        1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+        1e-2, 2.5e-2, 0.1,
+    )
+
+    def __init__(self, registry):
+        from beholder_tpu.metrics import get_or_create
+
+        self.query_seconds = get_or_create(
+            registry, "histogram",
+            "beholder_pg_query_seconds",
+            "Statement execution wall time by statement kind",
+            labelnames=["stmt"],
+            buckets=self.BUCKETS,
+        )
+        self.auth_seconds = get_or_create(
+            registry, "histogram",
+            "beholder_pg_auth_seconds",
+            "SCRAM-SHA-256 handshake wall time by outcome",
+            labelnames=["outcome"],
+            buckets=self.BUCKETS,
+        )
 
 
 def _cstr(s: str) -> bytes:
@@ -140,6 +174,18 @@ class _Handler(socketserver.BaseRequestHandler):
             return
 
     def _auth_scram(self, sock, take, server: "PgTestServer", user: str) -> bool:
+        t0 = time.perf_counter()
+        ok = self._auth_scram_inner(sock, take, server, user)
+        if server._metrics is not None:
+            server._metrics.auth_seconds.observe(
+                time.perf_counter() - t0,
+                outcome="ok" if ok else "failed",
+            )
+        return ok
+
+    def _auth_scram_inner(
+        self, sock, take, server: "PgTestServer", user: str
+    ) -> bool:
         sock.sendall(
             _msg(b"R", struct.pack(">I", 10) + _cstr("SCRAM-SHA-256") + b"\x00")
         )
@@ -206,9 +252,15 @@ class PgTestServer:
 
     COLUMNS = ("id", "name", "creator", "creator_id", "metadata_id", "status")
 
-    def __init__(self, password: str = ""):
+    def __init__(self, password: str = "", metrics=None):
         #: empty password = trust auth; non-empty = SCRAM-SHA-256
         self.password = password
+        #: optional Registry (or Metrics) for query/auth timing series
+        self._metrics = (
+            _PgMetrics(getattr(metrics, "registry", metrics))
+            if metrics is not None
+            else None
+        )
         self._scram_salt = os.urandom(16)
         self.rows: dict[str, dict] = {}
         self.queries: list[tuple[str, tuple]] = []  # for assertions
@@ -256,22 +308,31 @@ class PgTestServer:
 
     # -- the "SQL engine" ---------------------------------------------------
     def run_sql(self, sql: str, params: tuple) -> bytes:
+        t0 = time.perf_counter()
+        out, stmt = self._run_sql(sql, params)
+        if self._metrics is not None:
+            self._metrics.query_seconds.observe(
+                time.perf_counter() - t0, stmt=stmt
+            )
+        return out
+
+    def _run_sql(self, sql: str, params: tuple) -> tuple[bytes, str]:
         self.queries.append((sql, params))
         flat = " ".join(sql.split())
         try:
             if flat.upper().startswith("CREATE TABLE"):
-                return _msg(b"C", _cstr("CREATE TABLE"))
+                return _msg(b"C", _cstr("CREATE TABLE")), "create"
             if flat.startswith("INSERT INTO media"):
                 row = dict(zip(self.COLUMNS, params))
                 self.rows[row["id"]] = row
-                return _msg(b"C", _cstr("INSERT 0 1"))
+                return _msg(b"C", _cstr("INSERT 0 1")), "insert"
             if flat.startswith("UPDATE media SET status"):
                 status, media_id = params
                 row = self.rows.get(media_id)
                 if row is None:
-                    return _msg(b"C", _cstr("UPDATE 0"))
+                    return _msg(b"C", _cstr("UPDATE 0")), "update"
                 row["status"] = status
-                return _msg(b"C", _cstr("UPDATE 1"))
+                return _msg(b"C", _cstr("UPDATE 1")), "update"
             m = re.match(r"SELECT (.+) FROM media WHERE id = \$1", flat)
             if m:
                 cols = [c.strip() for c in m.group(1).split(",")]
@@ -281,10 +342,13 @@ class PgTestServer:
                 if row is not None:
                     out += self._data_row([row.get(c) for c in cols])
                     n = 1
-                return out + _msg(b"C", _cstr(f"SELECT {n}"))
-            return _error("42601", f"unrecognized statement: {flat[:80]}")
+                return out + _msg(b"C", _cstr(f"SELECT {n}")), "select"
+            return (
+                _error("42601", f"unrecognized statement: {flat[:80]}"),
+                "unrecognized",
+            )
         except Exception as err:  # noqa: BLE001 - report, don't die
-            return _error("XX000", repr(err))
+            return _error("XX000", repr(err)), "error"
 
     def _row_description(self, cols) -> bytes:
         body = struct.pack(">H", len(cols))

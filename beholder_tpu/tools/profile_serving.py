@@ -25,13 +25,18 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _slope(fn, n1: int = 2, n2: int = 10) -> float:
+def _slope(fn, n1: int = 2, n2: int = 10, label: str | None = None) -> float:
     """Marginal per-call seconds of ``fn(k)`` (k chained calls + one
-    readback): (T(n2) - T(n1)) / (n2 - n1), best of two rounds each."""
+    readback): (T(n2) - T(n1)) / (n2 - n1), best of two rounds each.
+    With ``label``, all four raw round times land in the artifact."""
+    from beholder_tpu import artifact
+
     fn(2)  # warm/compile
-    t1 = min(fn(n1) for _ in range(2))
-    t2 = min(fn(n2) for _ in range(2))
-    return (t2 - t1) / (n2 - n1)
+    t1s = [fn(n1) for _ in range(2)]
+    t2s = [fn(n2) for _ in range(2)]
+    if label is not None:
+        artifact.record_raw(label, "slope_timeit", t1s + t2s, k1=n1, k2=n2)
+    return (min(t2s) - min(t1s)) / (n2 - n1)
 
 
 def probe_latencies() -> dict[str, float]:
@@ -110,7 +115,9 @@ def profile_serving() -> dict[str, float]:
         float(np.asarray(d)[0, 0])
         return time.perf_counter() - t0
 
-    out["serve_wave_program_ms"] = _slope(run_serve) * 1e3
+    out["serve_wave_program_ms"] = _slope(
+        run_serve, label="profile.serve_wave"
+    ) * 1e3
 
     # wave scan alone (admitted state held fixed)
     admit = jax.jit(
@@ -132,7 +139,9 @@ def profile_serving() -> dict[str, float]:
         float(np.asarray(d)[0, 0])
         return time.perf_counter() - t0
 
-    out["wave_scan_program_ms"] = _slope(run_wave) * 1e3
+    out["wave_scan_program_ms"] = _slope(
+        run_wave, label="profile.wave_scan"
+    ) * 1e3
     out["us_per_tick"] = out["wave_scan_program_ms"] / (horizon - 1) * 1e3
 
     # full host path (what bench_serving times)
@@ -158,7 +167,9 @@ def profile_serving() -> dict[str, float]:
         float(np.asarray(o[-1])[0])
         return time.perf_counter() - t0
 
-    out["run_waves_host_path_ms"] = _slope(run_rw) * 1e3
+    out["run_waves_host_path_ms"] = _slope(
+        run_rw, label="profile.run_waves_host"
+    ) * 1e3
 
     # the dense rollout it is compared against
     prog = jnp.asarray(
@@ -177,16 +188,35 @@ def profile_serving() -> dict[str, float]:
         float(np.asarray(d)[0, 0])
         return time.perf_counter() - t0
 
-    out["dense_rollout_program_ms"] = _slope(run_roll) * 1e3
+    out["dense_rollout_program_ms"] = _slope(
+        run_roll, label="profile.dense_rollout"
+    ) * 1e3
     return out
 
 
 def main() -> None:
-    print("latency probes:", {
-        k: round(v, 3) for k, v in probe_latencies().items()
-    })
-    for k, v in profile_serving().items():
-        print(f"{k}: {v:.2f}")
+    import sys
+
+    from beholder_tpu import artifact
+
+    # same contract as bench.py: every profiling run leaves a
+    # schema-versioned raw artifact behind, even on error
+    rec = artifact.ArtifactRecorder("profile_serving")
+    artifact.set_current(rec)
+    try:
+        probes = rec.section("latency_probes", probe_latencies())
+        print("latency probes:", {
+            k: round(v, 3) for k, v in probes.items()
+        })
+        profile = rec.section("serving_profile", profile_serving())
+        for k, v in profile.items():
+            print(f"{k}: {v:.2f}")
+    except BaseException as err:
+        rec.error = repr(err)
+        raise
+    finally:
+        artifact.set_current(None)
+        print(f"profile artifact: {rec.write()}", file=sys.stderr)
 
 
 if __name__ == "__main__":

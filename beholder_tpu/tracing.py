@@ -25,6 +25,7 @@ Design (no opentracing/jaeger package exists in this image):
 
 from __future__ import annotations
 
+import contextvars
 import json
 import os
 import threading
@@ -34,6 +35,26 @@ from typing import Any, Callable
 
 TRACE_HEADER = "uber-trace-id"
 FLAG_SAMPLED = 0x01
+
+#: The span context active in this task/thread — set by ``with span:``
+#: blocks. Metric observations read it (metrics.py's observation log) to
+#: stamp raw latency samples with the trace that produced them.
+_ACTIVE: contextvars.ContextVar["SpanContext | None"] = contextvars.ContextVar(
+    "beholder_active_span", default=None
+)
+
+
+def active_context() -> "SpanContext | None":
+    """The :class:`SpanContext` of the innermost ``with span:`` block."""
+    return _ACTIVE.get()
+
+
+def current_trace_id() -> str | None:
+    """The active trace id as a 32-hex string, or None outside any span —
+    the cross-link key between jsonl span reports (``traceID``) and the
+    metrics observation log."""
+    ctx = _ACTIVE.get()
+    return f"{ctx.trace_id:032x}" if ctx is not None else None
 
 
 class SpanContext:
@@ -102,6 +123,7 @@ class Span:
         "logs",
         "_tracer",
         "_t0_ns",
+        "_activation",
     )
 
     def __init__(
@@ -145,9 +167,14 @@ class Span:
 
     # -- context manager ----------------------------------------------------
     def __enter__(self) -> "Span":
+        # entering makes this the ACTIVE span: nested start_span calls
+        # default to it as parent, and histogram observations inside the
+        # block carry its trace id (metrics.py observation log)
+        self._activation = _ACTIVE.set(self.context)
         return self
 
     def __exit__(self, exc_type, exc, _tb) -> None:
+        _ACTIVE.reset(self._activation)
         if exc is not None:
             self.set_tag("error", True)
             self.log("error", message=repr(exc))
@@ -171,7 +198,7 @@ class _NoopSpan:
     """Returned for unsampled traces: absorbs the Span API at near-zero
     cost and never reaches a reporter."""
 
-    __slots__ = ("context",)
+    __slots__ = ("context", "_activation")
 
     def __init__(self, context: SpanContext):
         self.context = context
@@ -188,10 +215,15 @@ class _NoopSpan:
     finished = True
 
     def __enter__(self) -> "_NoopSpan":
+        # an UNSAMPLED span must still become the active context: spans
+        # started inside it via the _ACTIVE fallback then inherit its
+        # cleared sample flag instead of minting (and independently
+        # re-sampling) a fresh root trace — a trace is never half-reported
+        self._activation = _ACTIVE.set(self.context)
         return self
 
     def __exit__(self, *exc_info) -> None:
-        pass
+        _ACTIVE.reset(self._activation)
 
 
 # -- reporters ---------------------------------------------------------------
@@ -274,7 +306,14 @@ class Tracer:
         child_of: SpanContext | Span | None = None,
         tags: dict[str, Any] | None = None,
     ) -> Span | _NoopSpan:
-        parent = child_of.context if isinstance(child_of, Span) else child_of
+        # accept Span AND _NoopSpan (an unsampled parent still carries
+        # the context whose flags suppress the whole trace)
+        parent = getattr(child_of, "context", child_of)
+        if parent is None:
+            # default to the active ``with span:`` block, so layers that
+            # know nothing of each other (consumer -> serving scheduler)
+            # still stitch into one trace
+            parent = _ACTIVE.get()
         if parent is not None:
             ctx = SpanContext(
                 trace_id=parent.trace_id,

@@ -1,0 +1,154 @@
+"""Bench artifact schema: recorder round-trip, validation, outcomes."""
+
+import json
+
+import pytest
+
+from beholder_tpu import artifact
+
+
+@pytest.fixture(autouse=True)
+def _no_global_recorder():
+    yield
+    artifact.set_current(None)
+
+
+def make_recorder():
+    rec = artifact.ArtifactRecorder("bench_test")
+    rec.section(
+        "service",
+        {"value": 123.4, "trials": [120.0, 123.4]},
+        metrics_before="# HELP x\n",
+        metrics_after="# HELP x\nx 1\n",
+    )
+    rec.record_raw(
+        "service.in_memory", "trial_wall", [0.5, 0.48], messages=60_000
+    )
+    return rec
+
+
+def test_artifact_round_trip_validates(tmp_path):
+    rec = make_recorder()
+    path = rec.write(str(tmp_path / "bench_test.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema"] == artifact.SCHEMA
+    assert obj["schema_version"] == artifact.SCHEMA_VERSION
+    assert obj["outcome"] == "ok"
+    section = obj["sections"]["service"]
+    assert section["result"]["value"] == 123.4
+    assert section["metrics_after"].endswith("x 1\n")
+    (raw,) = obj["raw_timings"]
+    assert raw["label"] == "service.in_memory"
+    assert raw["samples_s"] == [0.5, 0.48]
+    assert raw["messages"] == 60_000
+    prov = obj["provenance"]
+    assert isinstance(prov["python"], str) and isinstance(prov["platform"], str)
+
+
+def test_artifact_error_and_skip_outcomes(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_err")
+    rec.skip("accel", "tunnel down")
+    rec.error = "RuntimeError('boom')"
+    path = rec.write(str(tmp_path / "bench_err.json"))
+    obj = artifact.validate_file(path)
+    assert obj["outcome"] == "error"
+    assert obj["error"] == "RuntimeError('boom')"
+    assert obj["skipped"] == ["accel"]
+    assert obj["sections"]["accel"]["result"] == {"skipped": "tunnel down"}
+    # skip without error -> partial
+    rec2 = artifact.ArtifactRecorder("bench_partial")
+    rec2.skip("accel", "BENCH_QUICK=1")
+    assert rec2.to_dict()["outcome"] == "partial"
+
+
+def test_validate_rejects_malformed_artifacts():
+    with pytest.raises(ValueError, match="must be a dict"):
+        artifact.validate([])
+    good = make_recorder().to_dict()
+    artifact.validate(good)
+
+    bad = dict(good, schema="something-else")
+    with pytest.raises(ValueError, match="schema must be"):
+        artifact.validate(bad)
+    bad = dict(good, schema_version="1")
+    with pytest.raises(ValueError, match="schema_version"):
+        artifact.validate(bad)
+    bad = dict(good, outcome="error", error=None)
+    with pytest.raises(ValueError, match="outcome=error requires"):
+        artifact.validate(bad)
+    bad = dict(good, raw_timings=[{"label": 1, "method": "x", "samples_s": []}])
+    with pytest.raises(ValueError, match=r"raw_timings\[0\].label"):
+        artifact.validate(bad)
+    bad = dict(
+        good,
+        raw_timings=[{"label": "x", "method": "x", "samples_s": [1, "a"]}],
+    )
+    with pytest.raises(ValueError, match="samples_s"):
+        artifact.validate(bad)
+    bad = dict(good, sections={"s": {"no_result": 1}})
+    with pytest.raises(ValueError, match="section 's'"):
+        artifact.validate(bad)
+
+
+def test_section_snapshots_result_against_later_mutation():
+    """bench call sites keep assembling the dict they passed to section()
+    (``accel["flash"] = ...``); the stored section must not grow with it."""
+    rec = artifact.ArtifactRecorder("bench_mut")
+    result = rec.section("accel", {"value": 1.0})
+    result["flash"] = {"value": 2.0}
+    assert rec.sections["accel"]["result"] == {"value": 1.0}
+    assert result == {"value": 1.0, "flash": {"value": 2.0}}
+
+
+def test_record_raw_is_noop_without_current_recorder():
+    artifact.set_current(None)
+    artifact.record_raw("x", "y", [1.0])  # must not raise
+    rec = artifact.ArtifactRecorder("bench_cur")
+    artifact.set_current(rec)
+    artifact.record_raw("x", "y", [1.0])
+    assert rec.raw and rec.raw[0]["label"] == "x"
+
+
+def test_write_respects_artifact_dir_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_ARTIFACT_DIR", str(tmp_path / "arts"))
+    rec = artifact.ArtifactRecorder("bench_envdir")
+    path = rec.write()
+    assert path == str(tmp_path / "arts" / "bench_envdir.json")
+    artifact.validate_file(path)
+
+
+def test_committed_bench_artifacts_validate():
+    """Every artifact committed under artifacts/ must stay schema-valid
+    — the 'perf claims are backed by machine-checkable files' gate."""
+    import glob
+    import os
+
+    paths = glob.glob(os.path.join(artifact.DEFAULT_DIR, "*.json"))
+    assert paths, (
+        "no committed bench artifacts found under artifacts/ — run "
+        "`python bench.py` (BENCH_QUICK=1 for a smoke run) and commit "
+        "the result"
+    )
+    for path in paths:
+        obj = artifact.validate_file(path)
+        assert obj["raw_timings"], f"{path} carries no raw timings"
+
+
+def test_bench_main_writes_artifact_even_on_error(tmp_path, monkeypatch):
+    """bench.py's contract: ANY run leaves a schema-valid artifact, error
+    outcomes included."""
+    import bench
+
+    monkeypatch.setenv("BENCH_ARTIFACT_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        bench, "bench_service", lambda: (_ for _ in ()).throw(
+            RuntimeError("section exploded")
+        )
+    )
+    monkeypatch.setattr("sys.argv", ["bench.py"])
+    with pytest.raises(RuntimeError, match="section exploded"):
+        bench.main()
+    obj = artifact.validate_file(str(tmp_path / "bench_e2e.json"))
+    assert obj["outcome"] == "error"
+    assert "section exploded" in obj["error"]
+    assert json.dumps(obj)  # fully json-serializable

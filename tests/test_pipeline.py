@@ -484,11 +484,26 @@ def test_1f1b_wall_clock_tracks_tick_count(pp_mesh):
     # serialized schedule would produce; one re-measure absorbs a
     # transient load spike on a shared single-core host (observed: a
     # concurrent test run pushed the ratio past the bound once)
-    for attempt in range(2):
+    bound = (expected + serialized) / 2
+    ratio = first_ratio = timed(m_big) / timed(m_small)
+    if ratio >= bound:
         ratio = timed(m_big) / timed(m_small)
-        if ratio < (expected + serialized) / 2:
-            break
-    assert ratio < (expected + serialized) / 2, (
+        if ratio < bound:
+            # the retry halves sensitivity to a genuinely marginal
+            # scheduling regression, so surface the discarded first
+            # measurement (pytest prints warnings for passing tests —
+            # a ratio that keeps hovering at the bound stays visible
+            # in CI output instead of silently passing on retry)
+            import warnings
+
+            warnings.warn(
+                f"1F1B wall-clock ratio {first_ratio:.2f} exceeded the "
+                f"bound {bound:.2f} on the first measurement; the retry "
+                f"passed at {ratio:.2f} (load spike, or a marginal "
+                f"scheduling regression)",
+                stacklevel=1,
+            )
+    assert ratio < bound, (
         f"1F1B runtime ratio {ratio:.2f} vs expected ~{expected:.1f} "
         f"(serialized would be ~{serialized:.1f})"
     )

@@ -59,6 +59,7 @@ def test_ring_forward_matches_full():
     )
 
 
+@pytest.mark.slow  # ~1 min: grad-of-ring-collectives compile on CPU
 def test_ring_training_step_runs_sharded():
     feats, targets = _streams(seed=3)
     mesh = Mesh(np.array(jax.devices()[:8]), ("sp",))
@@ -80,6 +81,7 @@ def test_ring_without_mesh_raises():
         model.init(jax.random.PRNGKey(0), feats)
 
 
+@pytest.mark.slow  # ~1.5 min: compiles fwd+grad for all four backends
 def test_gqa_model_trains_on_every_backend():
     """kv_heads=2 with heads=8: flash/full attend grouped kv natively;
     ring/Ulysses broadcast kv groups before their sp collectives. All

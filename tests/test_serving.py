@@ -47,6 +47,7 @@ def _feats(req):
     )[0]
 
 
+@pytest.mark.slow  # ~50 s: compiles decode+paged kernels per variant
 @pytest.mark.parametrize(
     "model_kwargs",
     [
@@ -121,6 +122,7 @@ def test_paged_decode_matches_dense_teacher_forced(model_kwargs):
     assert not bool(state.alloc_failed)
 
 
+@pytest.mark.slow  # ~35 s: compiles admit/tick programs at many widths
 def test_continuous_batcher_end_to_end():
     """More requests than slots, mixed lengths/horizons: the batcher's
     fed-back forecasts track the product-level dense forecast (loose —
@@ -167,6 +169,7 @@ def test_continuous_batcher_end_to_end():
     assert not bool(batcher.state.active.any())
 
 
+@pytest.mark.slow  # ~30 s: compiles both the wave scan and host loop
 def test_run_waves_matches_run():
     """The on-device wave rollout (admit -> one compiled scan -> retire)
     returns the same forecasts as the per-tick host loop, at mixed
@@ -291,6 +294,7 @@ def test_serving_metrics_exported():
     assert "beholder_serving_tokens_total 26" in text
 
 
+@pytest.mark.slow  # ~20 s of wave-program compiles
 def test_run_waves_defers_ride_along_table_overflow():
     """A short-horizon request riding a long-horizon wave member would
     outgrow its own page table (round-4 review finding): the scheduler
@@ -322,6 +326,7 @@ def test_run_waves_defers_ride_along_table_overflow():
     assert int(batcher.state.free_top) == 24
 
 
+@pytest.mark.slow  # ~20 s: compiles bf16 AND int8 serve programs
 def test_int8_cache_tracks_bf16_and_halves_bytes():
     """cache_dtype=int8: forecasts track the bf16-cache batcher within
     quantization tolerance and the pool's HBM bytes drop ~2x."""
@@ -651,6 +656,7 @@ def test_fork_shares_pages_and_refcounts_release():
     assert set(np.asarray(st.free_stack).tolist()) == set(range(16))
 
 
+@pytest.mark.slow  # ~25 s: compiles the fork-wave program family
 def test_run_what_if_branches():
     """run_what_if(k branches): branch with the observed status equals
     the plain single-request forecast; a different hypothetical status
