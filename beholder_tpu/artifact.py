@@ -20,7 +20,7 @@ Schema (``validate`` is the authoritative checker)::
 
     {
       "schema": "beholder-bench-artifact",
-      "schema_version": 1,
+      "schema_version": 2,
       "name": "...",                      # bench_e2e / bench_accel / ...
       "created_unix_s": 1700000000.0,
       "wall_s": 12.3,
@@ -31,8 +31,17 @@ Schema (``validate`` is the authoritative checker)::
                                   "metrics_before": null | "<exposition>",
                                   "metrics_after": null | "<exposition>"}},
       "raw_timings": [{"label": ..., "method": ..., "samples_s": [...],
-                       ...extra}]
+                       ...extra}],
+      "reliability": {"retries": 0.0, "sheds": 0.0,
+                      "dead_lettered": 0.0}   # v2: reliability counters
     }
+
+Schema v2 (the reliability PR): every artifact carries the run's
+reliability counters — retries attempted, requests shed at the serving
+intake, messages dead-lettered — summed across the run's registries
+(:meth:`ArtifactRecorder.record_reliability`). A bench run that
+silently retried its way to a headline figure now says so in the
+artifact. v1 artifacts (no ``reliability`` key) remain valid.
 """
 
 from __future__ import annotations
@@ -44,7 +53,14 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+#: artifact key -> the counter family summed into it (across labels)
+RELIABILITY_COUNTERS = {
+    "retries": "beholder_retry_attempts_total",
+    "sheds": "beholder_serving_shed_total",
+    "dead_lettered": "beholder_dead_lettered_total",
+}
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
@@ -107,6 +123,9 @@ class ArtifactRecorder:
         self.raw: list[dict[str, Any]] = []
         self.error: str | None = None
         self.skipped: list[str] = []
+        self.reliability: dict[str, float] = {
+            key: 0.0 for key in RELIABILITY_COUNTERS
+        }
 
     def section(
         self,
@@ -143,6 +162,22 @@ class ArtifactRecorder:
         self.skipped.append(name)
         self.section(name, {"skipped": reason})
 
+    def record_reliability(self, registry) -> None:
+        """Accumulate one registry's reliability counters (retries,
+        sheds, dead-lettered) into the artifact. Benches build a fresh
+        registry per section, so sums ACCUMULATE across calls; a
+        registry without the series contributes zero."""
+        find = getattr(registry, "find", None)
+        if find is None:  # a Metrics wrapper
+            registry = getattr(registry, "registry", None)
+            find = getattr(registry, "find", None)
+            if find is None:
+                return
+        for key, name in RELIABILITY_COUNTERS.items():
+            counter = find(name)
+            if counter is not None:
+                self.reliability[key] += float(counter.total())
+
     def to_dict(self) -> dict[str, Any]:
         outcome = "ok"
         if self.error is not None:
@@ -161,6 +196,7 @@ class ArtifactRecorder:
             "provenance": provenance(),
             "sections": self.sections,
             "raw_timings": self.raw,
+            "reliability": dict(self.reliability),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -197,6 +233,13 @@ def record_raw(
     so timing helpers can call it unconditionally."""
     if _CURRENT is not None:
         _CURRENT.record_raw(label, method, samples_s, **extra)
+
+
+def record_reliability(registry) -> None:
+    """Accumulate a registry's reliability counters into the active
+    recorder; no-op without one (same contract as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_reliability(registry)
 
 
 # -- validation ---------------------------------------------------------------
@@ -236,6 +279,18 @@ def validate(obj: Any) -> None:
         for name, section in sections.items():
             if not isinstance(section, dict) or "result" not in section:
                 problems.append(f"section {name!r} must be a dict with 'result'")
+    if isinstance(version, int) and version >= 2:
+        # v2: reliability counters are part of the evidence
+        rel = obj.get("reliability")
+        if not isinstance(rel, dict):
+            problems.append("reliability must be a dict (schema v2+)")
+        else:
+            for key in RELIABILITY_COUNTERS:
+                if not isinstance(rel.get(key), (int, float)):
+                    problems.append(
+                        f"reliability.{key} must be a number, "
+                        f"got {rel.get(key)!r}"
+                    )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):
         problems.append("raw_timings must be a list")

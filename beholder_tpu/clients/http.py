@@ -61,14 +61,27 @@ class RequestsTransport(HttpTransport):
         return HttpResponse(status=resp.status_code, body=body)
 
 
+def is_timeout_error(exc: BaseException) -> bool:
+    """Transport-agnostic timeout detection: stdlib ``TimeoutError``
+    (``socket.timeout`` is its alias since 3.10) plus duck-typing for
+    requests' ``Timeout``/``ConnectTimeout``/``ReadTimeout`` — checked
+    by class NAME so this module never imports requests."""
+    if isinstance(exc, TimeoutError):
+        return True
+    return any("Timeout" in klass.__name__ for klass in type(exc).__mro__)
+
+
 class TimedTransport(HttpTransport):
     """Wraps any transport with a request-latency histogram
     (``beholder_http_request_seconds{method,outcome}``). Extension
     surface: nothing is registered unless one is constructed (the
     service wires it behind ``instance.observability.enabled``), so the
     reference exposition stays byte-identical by default. ``outcome``
-    is the status class (``2xx``/``4xx``/...) or ``error`` when the
-    transport raised before producing a response."""
+    is the status class (``2xx``/``4xx``/...), ``timeout`` when the
+    transport raised a timeout, or ``error`` for any other raise —
+    deadline misses and dependency errors are different failure modes
+    and alert differently (a timeout spike says "slow dependency or
+    deadline too tight", not "dependency down")."""
 
     def __init__(self, inner: HttpTransport, registry):
         from beholder_tpu.metrics import get_or_create
@@ -88,10 +101,10 @@ class TimedTransport(HttpTransport):
             resp = self.inner.request(
                 method, url, params=params, json=json, timeout=timeout
             )
-        except Exception:
+        except Exception as err:
             self._hist.observe(
                 time.perf_counter() - t0, method=method.upper(),
-                outcome="error",
+                outcome="timeout" if is_timeout_error(err) else "error",
             )
             raise
         self._hist.observe(

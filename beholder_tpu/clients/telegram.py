@@ -19,12 +19,16 @@ class TelegramClient:
         token: str,
         transport: HttpTransport | None = None,
         base_url: str | None = None,
+        deadline_s: float = 10.0,
     ):
         self._token = token
         self._transport = transport or RequestsTransport()
         # TELEGRAM_API_URL lets tests/self-hosted setups redirect traffic
         base_url = base_url or os.environ.get("TELEGRAM_API_URL", BASE_URL)
         self._base_url = base_url.rstrip("/")
+        #: per-request time budget handed to the transport (the service
+        #: threads ``instance.http.deadline_s`` here)
+        self._deadline_s = float(deadline_s)
 
     def send_message(
         self, chat_id: str, text: str, parse_mode: str = "markdown"
@@ -33,6 +37,7 @@ class TelegramClient:
             "get",  # request-promise-native defaults to GET (index.js:99)
             f"{self._base_url}/bot{self._token}/sendMessage",
             params={"chat_id": chat_id, "text": text, "parse_mode": parse_mode},
+            timeout=self._deadline_s,
         )
         resp.raise_for_status()
         return resp

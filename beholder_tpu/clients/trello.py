@@ -22,6 +22,7 @@ class TrelloClient:
         token: str,
         transport: HttpTransport | None = None,
         base_url: str | None = None,
+        deadline_s: float = 10.0,
     ):
         self._key = key
         self._token = token
@@ -29,6 +30,9 @@ class TrelloClient:
         # TRELLO_API_URL lets tests/self-hosted setups redirect traffic
         base_url = base_url or os.environ.get("TRELLO_API_URL", BASE_URL)
         self._base_url = base_url.rstrip("/")
+        #: per-request time budget handed to the transport (the service
+        #: threads ``instance.http.deadline_s`` here)
+        self._deadline_s = float(deadline_s)
 
     def make_request(
         self, method: str, path: str, params: dict[str, Any] | None = None
@@ -37,7 +41,8 @@ class TrelloClient:
         merged = {"key": self._key, "token": self._token}
         merged.update(params or {})
         resp = self._transport.request(
-            method, f"{self._base_url}{path}", params=merged
+            method, f"{self._base_url}{path}", params=merged,
+            timeout=self._deadline_s,
         )
         resp.raise_for_status()
         return resp

@@ -238,8 +238,13 @@ def health_from_config(config, service) -> HealthServer | None:
     """Build the service's health endpoint from ``instance.health.*``
     config (``enabled``, ``port``), or None when disabled (the default).
 
-    Registered checks: ``broker`` (connection liveness) and ``db`` (a
-    probe read). ``/readyz`` flips once the consumers are registered.
+    Registered checks: ``broker`` (connection liveness), ``db`` (a
+    probe read), and — when the reliability subsystem is enabled —
+    ``breaker`` (an OPEN outbound-HTTP circuit breaker means a
+    dependency is sick and calls are being fast-failed: the probe
+    reports degraded so the orchestrator/operator sees it, while
+    half-open probes recover it without a restart). ``/readyz`` flips
+    once the consumers are registered.
     """
     if not config.get("instance.health.enabled"):
         return None
@@ -259,6 +264,21 @@ def health_from_config(config, service) -> HealthServer | None:
         return True
 
     server.add_check("db", db_check)
+
+    if getattr(service, "breaker", None) is not None:
+        circuit = service.breaker
+
+        def breaker_check():
+            state = circuit.state
+            if state == "open":
+                raise RuntimeError(
+                    f"circuit breaker {circuit.name!r} is open "
+                    f"(failure rate {circuit.failure_rate():.0%})"
+                )
+            return state  # "closed"/"half_open" as the check detail
+
+        server.add_check("breaker", breaker_check)
+
     server.start()
     server.set_ready(True)
     return server

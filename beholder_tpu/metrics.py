@@ -104,6 +104,11 @@ class Counter(_Labelled):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination (artifact snapshots)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def render(self) -> str:
         with self._lock:
             items = sorted(self._values.items())

@@ -860,6 +860,14 @@ class ContinuousBatcher:
     (admit/tick/retire/wave/readback); histogram observations made
     inside those spans carry the trace id in the metrics observation
     log, so a latency outlier cross-links to its serving timeline.
+
+    ``max_pending``/``max_pending_pages`` (or an explicit ``intake``
+    :class:`~beholder_tpu.reliability.shed.IntakeQueue`) put admission
+    control in front of the schedulers: :meth:`submit` offers a request
+    to a BOUNDED queue and returns an explicit accept/shed outcome
+    (``beholder_serving_shed_total{reason}`` when a registry is wired),
+    :meth:`run_pending` drains and serves. Without them the batcher
+    keeps its original call-with-a-list contract.
     """
 
     def __init__(
@@ -875,6 +883,9 @@ class ContinuousBatcher:
         cache_dtype=jnp.bfloat16,
         metrics=None,
         tracer=None,
+        intake=None,
+        max_pending: int | None = None,
+        max_pending_pages: int | None = None,
     ):
         self.model = model
         self.params = params
@@ -895,6 +906,25 @@ class ContinuousBatcher:
             else None
         )
         self._tracer = tracer
+        #: optional admission control (reliability subsystem): a bounded
+        #: intake in front of the schedulers — submit() yields an
+        #: explicit accept/shed outcome instead of unbounded queueing
+        if intake is None and (
+            max_pending is not None or max_pending_pages is not None
+        ):
+            from beholder_tpu.reliability.shed import IntakeQueue
+
+            intake = IntakeQueue(
+                max_pending if max_pending is not None else 2 * slots,
+                max_cost=max_pending_pages,
+                cost_fn=self._need_pages,
+                metrics=(
+                    getattr(metrics, "registry", metrics)
+                    if metrics is not None
+                    else None
+                ),
+            )
+        self.intake = intake
         self._release_many = jax.jit(paged_release_many)
         self._tick_carry = jax.jit(
             lambda p, s, c, w: _tick_with_carry(model, p, s, c, w)
@@ -1011,6 +1041,40 @@ class ContinuousBatcher:
                     f"prefix {t} exceeds max_prefix {self.max_prefix}"
                 )
             self._check_servable(req)
+
+    # -- admission control: bounded intake + shed -----------------------
+
+    def submit(self, request: Request):
+        """Offer one request to the bounded intake queue; returns an
+        :class:`~beholder_tpu.reliability.shed.Admission` — accepted, or
+        shed with an explicit reason (``queue_full`` / ``cost_backlog``
+        / ``oversized``). Saying no costs O(1) and nothing on device;
+        unbounded queueing under overload would convert load into
+        latency + memory instead. Requires the batcher to be built with
+        ``intake=``/``max_pending=``."""
+        if self.intake is None:
+            raise RuntimeError(
+                "no intake queue configured — construct the batcher with "
+                "max_pending= (or an explicit IntakeQueue) to use submit()"
+            )
+        from beholder_tpu.reliability.shed import SHED_OVERSIZED
+
+        need = self._need_pages(request)
+        if need > self.num_pages or need > self.max_pages_per_seq:
+            # unservable at ANY load: shed rather than poison a run
+            return self.intake.shed(SHED_OVERSIZED)
+        return self.intake.offer(request, cost=need)
+
+    def run_pending(self, waves: bool = True) -> list[np.ndarray]:
+        """Drain the intake queue and serve everything admitted since
+        the last drain (``run_waves`` by default, ``run`` with
+        ``waves=False``). Results are in admission order."""
+        if self.intake is None:
+            raise RuntimeError("no intake queue configured")
+        pending = self.intake.take_all()
+        if not pending:
+            return []
+        return self.run_waves(pending) if waves else self.run(pending)
 
     # -- flexible path: per-tick scheduling -----------------------------
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import queue as queue_mod
+import random
 import threading
 from dataclasses import dataclass
 from urllib.parse import unquote, urlparse
@@ -32,6 +33,9 @@ DEFAULT_PORT = 5672
 FRAME_MAX = 131072
 HEARTBEAT = 30
 RECONNECT_DELAY_S = 1.0
+#: backoff ceiling for the reconnect loop (base = reconnect_delay, full
+#: jitter between base and the doubling cap)
+RECONNECT_MAX_DELAY_S = 30.0
 
 
 @dataclass
@@ -231,8 +235,8 @@ class _Protocol(asyncio.Protocol):
             pass
 
     # -- outgoing operations (called from the loop thread) ------------------
-    def declare_and_consume(self, queue: str) -> None:
-        declare = (
+    def declare(self, queue: str) -> None:
+        args = (
             codec.Writer()
             .short(0)
             .shortstr(queue)
@@ -240,7 +244,10 @@ class _Protocol(asyncio.Protocol):
             .table({})
             .getvalue()
         )
-        self._send_method(1, codec.QUEUE_DECLARE, declare)
+        self._send_method(1, codec.QUEUE_DECLARE, args)
+
+    def declare_and_consume(self, queue: str) -> None:
+        self.declare(queue)
         consume = (
             codec.Writer()
             .short(0)
@@ -306,6 +313,7 @@ class AmqpBroker(Broker):
         self.heartbeat = heartbeat
         self._log = get_logger("mq.amqp")
         self._handlers: dict[str, Handler] = {}
+        self._declared: set[str] = set()  # consumer-less queues (e.g. DLQs)
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: threading.Thread | None = None
         self._protocol: _Protocol | None = None
@@ -348,6 +356,14 @@ class AmqpBroker(Broker):
         self._handlers[topic] = handler
         self._call_on_loop(lambda p: p.declare_and_consume(topic))
 
+    def declare(self, topic: str) -> None:
+        """Declare ``topic``'s queue (durable) without consuming — a
+        publish-only destination like a DLQ must exist server-side or
+        default-exchange publishes to it are silently unroutable.
+        Re-declared on every reconnect, like consumers."""
+        self._declared.add(topic)
+        self._call_on_loop(lambda p: p.declare(topic))
+
     def publish(self, topic: str, body: bytes, headers: dict | None = None) -> None:
         payload = bytes(body)
 
@@ -385,6 +401,10 @@ class AmqpBroker(Broker):
             self._loop_thread.join(timeout=5)
         if self._dispatch_thread is not None:
             self._dispatch_thread.join(timeout=5)
+        if self._loop is not None and not self._loop.is_running():
+            # the loop stopped above; release its selector/self-pipe fds
+            # (GC would otherwise warn "event loop not closed")
+            self._loop.close()
 
     # -- loop-side ----------------------------------------------------------
     def _run_loop(self) -> None:
@@ -402,6 +422,7 @@ class AmqpBroker(Broker):
         self._connecting = True
         creds = AmqpUrl.parse(self.url)
         loop = asyncio.get_event_loop()
+        attempt = 0
         try:
             while not self._closing:
                 try:
@@ -412,6 +433,8 @@ class AmqpBroker(Broker):
                     await protocol.ready
                     for topic in self._handlers:
                         protocol.declare_and_consume(topic)
+                    for topic in self._declared:
+                        protocol.declare(topic)
                     buffered, self._publish_buffer = self._publish_buffer, []
                     for topic, body, headers in buffered:
                         protocol.publish(topic, body, headers)
@@ -423,11 +446,22 @@ class AmqpBroker(Broker):
                     self._log.info(f"connected to {creds.host}:{creds.port}")
                     return
                 except (OSError, ConnectionError) as err:
+                    # bounded exponential backoff with jitter (uniform over
+                    # [base, cap]): a fleet of consumers losing one broker
+                    # must not reconnect in lockstep
+                    attempt += 1
+                    cap = min(
+                        self.reconnect_delay * 2 ** (attempt - 1),
+                        max(self.reconnect_delay, RECONNECT_MAX_DELAY_S),
+                    )
+                    delay = self.reconnect_delay + random.random() * max(
+                        cap - self.reconnect_delay, 0.0
+                    )
                     self._log.warning(
                         f"connect to {creds.host}:{creds.port} failed: {err}; "
-                        f"retrying in {self.reconnect_delay}s"
+                        f"retrying in {delay:.2f}s (attempt {attempt})"
                     )
-                    await asyncio.sleep(self.reconnect_delay)
+                    await asyncio.sleep(delay)
         finally:
             self._connecting = False
 

@@ -9,6 +9,17 @@ Contract notes, all observable in the reference:
   (index.js:62,127). Topics are queue names ("v1.telemetry.status").
 - Prefetch bounds the number of unacked deliveries in flight
   (100 in the reference, index.js:43).
+
+Reliability extensions (opt-in; the defaults keep reference semantics):
+
+- ``Delivery.redelivered`` distinguishes first delivery from redelivery
+  on every broker (AMQP wire flag, in-memory requeue flag), and
+  ``Delivery.delivery_count`` exposes the broker-stamped attempt count
+  (``x-delivery-count``) that bounded-retry/DLQ logic needs.
+- Brokers may route ``nack(requeue=False)`` rejections and expired
+  messages to a per-queue dead-letter queue instead of dropping them
+  (see ``InMemoryBroker.set_dead_letter`` /
+  ``AmqpTestServer.set_dead_letter`` + ``set_message_ttl``).
 """
 
 from __future__ import annotations
@@ -18,6 +29,13 @@ from typing import Callable
 
 #: A consumer callback. Must call ``delivery.ack()`` (or ``nack``) itself.
 Handler = Callable[["Delivery"], None]
+
+#: Broker-stamped count of PRIOR delivery attempts (the RabbitMQ
+#: quorum-queue ``x-delivery-count`` contract): absent/0 on first
+#: delivery, incremented each time the message is requeued. Retry
+#: counting builds on this — ``redelivered`` alone says "not the first
+#: attempt" but not WHICH attempt.
+DELIVERY_COUNT_HEADER = "x-delivery-count"
 
 
 class Delivery:
@@ -63,6 +81,20 @@ class Delivery:
     def settled(self) -> bool:
         return self._settle is None
 
+    @property
+    def delivery_count(self) -> int:
+        """Prior delivery attempts of this message (0 on first delivery).
+
+        Read from the broker-stamped :data:`DELIVERY_COUNT_HEADER`; both
+        in-repo brokers stamp it on every requeue, and the
+        ``redelivered`` flag remains the cheap boolean view of the same
+        fact (``delivery_count > 0`` implies ``redelivered``). Malformed
+        values degrade to 0, never raise — headers are peer input."""
+        try:
+            return max(int(self.headers.get(DELIVERY_COUNT_HEADER, 0)), 0)
+        except (TypeError, ValueError):
+            return 0
+
 
 class Broker(abc.ABC):
     """Minimal broker contract used by the service layer."""
@@ -81,6 +113,15 @@ class Broker(abc.ABC):
 
         ``headers`` ride the AMQP basic-properties headers table — used for
         trace-context propagation, never required by consumers."""
+
+    def declare(self, topic: str) -> None:
+        """Ensure ``topic``'s queue exists WITHOUT consuming from it.
+
+        Publishing to a queue nobody has declared is silently unroutable
+        on a real AMQP broker (default-exchange publish, mandatory=0) —
+        a dead-letter parking lot must therefore be declared up front or
+        parked messages would be dropped, not parked. Default: no-op
+        (the in-memory broker materializes queues on first publish)."""
 
     @abc.abstractmethod
     def close(self) -> None:

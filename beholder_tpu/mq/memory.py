@@ -2,18 +2,25 @@
 
 Implements the same observable semantics as the AMQP path: per-topic FIFO
 queues, a prefetch window bounding unacked deliveries, and
-requeue-on-nack redelivery (flagged ``redelivered``). Delivery is
+requeue-on-nack redelivery (flagged ``redelivered``, with the
+``x-delivery-count`` attempt header stamped on each requeue). Delivery is
 synchronous and single-threaded, which makes ack-semantics tests exact.
+
+Dead-letter routing (``set_dead_letter``): a ``nack(requeue=False)`` on
+a routed topic republishes the message to its dead-letter topic (with
+``x-beholder-death-*`` provenance headers) instead of dropping it —
+the in-memory twin of RabbitMQ's ``x-dead-letter-exchange``.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
 from beholder_tpu.log import get_logger
 
-from .base import Broker, Delivery, Handler
+from .base import DELIVERY_COUNT_HEADER, Broker, Delivery, Handler
 
 
 @dataclass
@@ -35,6 +42,9 @@ class InMemoryBroker(Broker):
         self._next_tag = 1
         self._connected = False
         self._dispatching = False
+        self._dead_letter: dict[str, str] = {}  # topic -> DLQ topic
+        #: (topic, reason) -> count; introspection for tests/metrics
+        self.dead_lettered: dict[tuple[str, str], int] = {}
         self._log = get_logger("mq.memory")
 
     @property
@@ -63,6 +73,13 @@ class InMemoryBroker(Broker):
         self._pending_total += 1
         if self._connected:
             self._dispatch()
+
+    def set_dead_letter(self, topic: str, dlq_topic: str) -> None:
+        """Route ``nack(requeue=False)`` rejections on ``topic`` to
+        ``dlq_topic`` instead of dropping them."""
+        if dlq_topic == topic:
+            raise ValueError(f"dead-letter loop: {topic!r} -> itself")
+        self._dead_letter[topic] = dlq_topic
 
     # -- introspection for tests -------------------------------------------
     @property
@@ -114,10 +131,15 @@ class InMemoryBroker(Broker):
                     except Exception as err:  # noqa: BLE001
                         # a throwing handler leaves its delivery unacked —
                         # same outcome as an unhandled rejection in the
-                        # reference's consumer callbacks (SURVEY.md §3b)
+                        # reference's consumer callbacks (SURVEY.md §3b).
+                        # (A reliability wrapper may have settled before
+                        # re-raising; then there is nothing left in flight.)
+                        state = (
+                            "already settled" if delivery.settled
+                            else f"delivery {tag} left unacked"
+                        )
                         self._log.warning(
-                            f"handler for {topic!r} raised: {err!r}; "
-                            f"delivery {tag} left unacked"
+                            f"handler for {topic!r} raised: {err!r}; {state}"
                         )
         finally:
             self._dispatching = False
@@ -125,8 +147,25 @@ class InMemoryBroker(Broker):
     def _settle(self, tag: int, acked: bool, requeue: bool) -> None:
         topic, body, headers = self._unacked.pop(tag)
         if not acked and requeue:
+            # stamp the attempt count for the next delivery (quorum-queue
+            # x-delivery-count contract); COPY the headers — the dict is
+            # shared with the delivery the consumer may still hold
+            headers = dict(headers or {})
+            headers[DELIVERY_COUNT_HEADER] = (
+                int(headers.get(DELIVERY_COUNT_HEADER, 0) or 0) + 1
+            )
             self._topics[topic].pending.appendleft((body, True, headers))
             self._pending_total += 1
+        elif not acked:
+            dlq = self._dead_letter.get(topic)
+            if dlq is not None:
+                key = (topic, "rejected")
+                self.dead_lettered[key] = self.dead_lettered.get(key, 0) + 1
+                headers = dict(headers or {})
+                headers.setdefault("x-beholder-death-queue", topic)
+                headers.setdefault("x-beholder-death-reason", "rejected")
+                headers.setdefault("x-beholder-death-unix-s", int(time.time()))
+                self.publish(dlq, body, headers=headers)
         # a freed prefetch slot (or a requeue) may unblock pending work;
         # re-entrant calls return immediately and the outer loop continues
         self._dispatch()

@@ -12,18 +12,28 @@ Semantics implemented (matching RabbitMQ's observable behavior):
 - per-queue FIFO with round-robin across consumers,
 - per-connection prefetch window (basic.qos),
 - unacked messages requeued (redelivered=1) when a connection drops,
-- basic.nack with requeue.
+  with the quorum-queue ``x-delivery-count`` header stamped per requeue,
+- basic.nack with requeue,
+- per-queue dead-letter routing (``set_dead_letter``): rejected
+  (``nack(requeue=False)``) and expired messages are republished to the
+  queue's DLQ with ``x-beholder-death-*`` provenance headers — the
+  in-process stand-in for ``x-dead-letter-exchange``,
+- per-queue message TTL (``set_message_ttl``): head-of-queue expiry on
+  every pump, RabbitMQ's per-queue ``x-message-ttl`` behavior — the
+  knob that makes expiry->dead-letter paths testable in-process.
 """
 
 from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from collections import deque
 
 from beholder_tpu.log import get_logger
 
 from . import codec
+from .base import DELIVERY_COUNT_HEADER
 
 #: (class, method) -> spec name, for the per-method frame counter labels
 _METHOD_NAMES = {
@@ -66,6 +76,15 @@ class _BrokerMetrics:
             "in-flight deliveries)",
             labelnames=["queue"],
         )
+        # shares the reliability catalog's name: broker-side routing and
+        # consumer-side parking land on one series
+        self.dead_lettered_total = get_or_create(
+            registry, "counter",
+            "beholder_dead_lettered_total",
+            "Messages parked on a dead-letter queue, by source queue and "
+            "reason (max-retries/rejected/expired)",
+            labelnames=["queue", "reason"],
+        )
         self._bound: dict = {}  # method cm -> bound counter child
 
     def count_method(self, cm) -> None:
@@ -87,7 +106,12 @@ class _Conn(asyncio.Protocol):
         self.transport: asyncio.Transport | None = None
         self.saw_header = False
         self.prefetch = 0  # 0 = unlimited
-        self.unacked: dict[int, tuple[str, bytes, dict]] = {}
+        #: tag -> (queue, body, headers, enqueued_at); the ORIGINAL
+        #: enqueue time rides along so a requeue keeps the message's age
+        #: (RabbitMQ measures per-queue TTL from publish, not redelivery
+        #: — a freshly-stamped requeue at the head would also hide older
+        #: expired messages from the head-of-queue expiry scan)
+        self.unacked: dict[int, tuple[str, bytes, dict, float]] = {}
         self.consumes: dict[str, str] = {}  # queue -> consumer tag
         self.next_tag = 1
         # in-flight publish: [routing_key, expected_size, chunks, headers]
@@ -104,10 +128,13 @@ class _Conn(asyncio.Protocol):
         if self._hb_task is not None:
             self._hb_task.cancel()
         self.server.conns.discard(self)
-        # requeue unacked at the front, flagged redelivered (RabbitMQ behavior)
-        for _tag, (queue, body, headers) in sorted(self.unacked.items(), reverse=True):
+        # requeue unacked at the front, flagged redelivered (RabbitMQ
+        # behavior), attempt count stamped (quorum-queue x-delivery-count)
+        for _tag, (queue, body, headers, enq) in sorted(
+            self.unacked.items(), reverse=True
+        ):
             self.server.queues.setdefault(queue, deque()).appendleft(
-                (body, True, headers)
+                (body, True, _bump_delivery_count(headers), enq)
             )
         self.unacked.clear()
         for queue in self.consumes:
@@ -257,10 +284,15 @@ class _Conn(asyncio.Protocol):
             requeue = bool(flags & 2)
             entry = self.unacked.pop(tag, None)
             if entry is not None and requeue:
-                queue, body, headers = entry
+                queue, body, headers, enq = entry
                 self.server.queues.setdefault(queue, deque()).appendleft(
-                    (body, True, headers)
+                    (body, True, _bump_delivery_count(headers), enq)
                 )
+            elif entry is not None:
+                # rejected outright: dead-letter route when configured
+                # (RabbitMQ x-dead-letter-exchange), else drop
+                queue, body, headers, _enq = entry
+                self.server.dead_letter_route(queue, body, headers, "rejected")
             self.server.pump()
         elif cm == codec.CONNECTION_CLOSE:
             self._send_method(0, codec.CONNECTION_CLOSE_OK)
@@ -284,7 +316,7 @@ class _Conn(asyncio.Protocol):
             return
         self._pending = None
         self.server.queues.setdefault(pending[0], deque()).append(
-            (body, False, pending[3])
+            (body, False, pending[3], time.monotonic())
         )
         self.server.pump()
 
@@ -293,11 +325,19 @@ class _Conn(asyncio.Protocol):
         return self.prefetch == 0 or len(self.unacked) < self.prefetch
 
     def deliver(
-        self, queue: str, body: bytes, redelivered: bool, headers: dict
+        self,
+        queue: str,
+        body: bytes,
+        redelivered: bool,
+        headers: dict,
+        enqueued_at: float | None = None,
     ) -> None:
         tag = self.next_tag
         self.next_tag += 1
-        self.unacked[tag] = (queue, body, headers)
+        self.unacked[tag] = (
+            queue, body, headers,
+            time.monotonic() if enqueued_at is None else enqueued_at,
+        )
         args = (
             codec.Writer()
             .shortstr(self.consumes[queue])
@@ -327,6 +367,19 @@ def codec_frame_max() -> int:
     return 131072
 
 
+def _bump_delivery_count(headers: dict | None) -> dict:
+    """Copy ``headers`` with the x-delivery-count attempt header
+    incremented (copied: the original dict may still be referenced by a
+    delivery a consumer holds)."""
+    out = dict(headers or {})
+    try:
+        prior = int(out.get(DELIVERY_COUNT_HEADER, 0) or 0)
+    except (TypeError, ValueError):
+        prior = 0
+    out[DELIVERY_COUNT_HEADER] = prior + 1
+    return out
+
+
 class AmqpTestServer:
     """In-process AMQP broker bound to 127.0.0.1 on an ephemeral port."""
 
@@ -352,6 +405,8 @@ class AmqpTestServer:
         )
         self._requested_port = port
         self.queues: dict[str, deque] = {}
+        self._dead_letter: dict[str, str] = {}  # queue -> DLQ queue
+        self._message_ttl: dict[str, float] = {}  # queue -> TTL seconds
         self.consumers: dict[str, list[_Conn]] = {}
         self.conns: set[_Conn] = set()
         self.port: int | None = None
@@ -419,18 +474,79 @@ class AmqpTestServer:
     def queue_depth(self, queue: str) -> int:
         return len(self.queues.get(queue, ()))
 
+    # -- reliability knobs --------------------------------------------------
+    def set_dead_letter(self, queue: str, dlq: str) -> None:
+        """Route ``queue``'s rejected and expired messages to ``dlq``
+        (the x-dead-letter-exchange behavior, as a direct knob)."""
+        if dlq == queue:
+            raise ValueError(f"dead-letter loop: {queue!r} -> itself")
+        self._dead_letter[queue] = dlq
+
+    def set_message_ttl(self, queue: str, ttl_s: float) -> None:
+        """Per-queue message TTL (x-message-ttl): messages older than
+        ``ttl_s`` expire at the head of the queue on the next pump —
+        dead-lettered when a DLQ is routed, dropped otherwise."""
+        if ttl_s < 0:
+            raise ValueError(f"ttl must be >= 0, got {ttl_s}")
+        self._message_ttl[queue] = float(ttl_s)
+
+    def dead_letter_route(
+        self, queue: str, body: bytes, headers: dict, reason: str
+    ) -> None:
+        """Move one dead message to ``queue``'s DLQ (drop when none is
+        configured), stamping death-provenance headers and the
+        dead-letter counter either way."""
+        if self._metrics is not None:
+            self._metrics.dead_lettered_total.inc(queue=queue, reason=reason)
+        dlq = self._dead_letter.get(queue)
+        if dlq is None:
+            return
+        headers = dict(headers or {})
+        headers.setdefault("x-beholder-death-queue", queue)
+        headers.setdefault("x-beholder-death-reason", reason)
+        headers.setdefault("x-beholder-death-unix-s", int(time.time()))
+        self.queues.setdefault(dlq, deque()).append(
+            (body, False, headers, time.monotonic())
+        )
+
+    def _expire(self, now: float) -> bool:
+        """Head-of-queue TTL expiry across every routed queue; True when
+        anything moved (so pump's delivery pass sees fresh DLQ work)."""
+        moved = False
+        for queue, ttl in self._message_ttl.items():
+            pending = self.queues.get(queue)
+            while pending:
+                entry = pending[0]
+                enqueued_at = entry[3] if len(entry) > 3 else now
+                if now - enqueued_at < ttl:
+                    # ages are non-decreasing front->back: publishes
+                    # append FRESH at the back, requeues appendleft with
+                    # their ORIGINAL (older) stamp — a young head really
+                    # does mean nothing behind it is expired
+                    break
+                pending.popleft()
+                self.dead_letter_route(queue, entry[0], entry[2], "expired")
+                moved = True
+        return moved
+
     # -- scheduling ---------------------------------------------------------
     def pump(self) -> None:
-        """Deliver queued messages to consumers with free prefetch slots."""
-        for queue, pending in self.queues.items():
+        """Deliver queued messages to consumers with free prefetch slots
+        (after expiring TTL-overdue heads into their DLQs)."""
+        if self._message_ttl:
+            self._expire(time.monotonic())
+        for queue, pending in list(self.queues.items()):
             consumers = [
                 c for c in self.consumers.get(queue, []) if c.can_take()
             ]
             while pending and consumers:
-                body, redelivered, headers = pending.popleft()
+                body, redelivered, headers, *rest = pending.popleft()
                 idx = self._rr.get(queue, 0) % len(consumers)
                 self._rr[queue] = idx + 1
-                consumers[idx].deliver(queue, body, redelivered, headers)
+                consumers[idx].deliver(
+                    queue, body, redelivered, headers,
+                    enqueued_at=rest[0] if rest else None,
+                )
                 consumers = [c for c in consumers if c.can_take()]
         # pump() runs after every queue mutation (publish, ack, nack,
         # consume, connection loss), so refreshing the gauges here keeps

@@ -1,0 +1,72 @@
+"""Reliability subsystem: retries, circuit breakers, dead-letter
+queues, and load shedding.
+
+The measurement layer (PR 1) made failure visible; this package makes
+the system survive it. Four composable pieces, each wired through the
+I/O layer it protects:
+
+- :mod:`.policy` — retry policies (bounded exponential backoff, full
+  jitter, retry budgets) and deadline propagation.
+- :mod:`.breaker` — a closed/open/half-open circuit breaker and the
+  :class:`~.breaker.ResilientTransport` that puts it (plus retries and
+  deadlines) in front of every outbound HTTP client.
+- :mod:`.dlq` — consumer-side at-least-once delivery: bounded
+  redelivery then dead-letter parking, with an idempotency window so
+  redeliveries stay effectively-once. (Broker-side DLQ routing and
+  message TTL live with the brokers in :mod:`beholder_tpu.mq`.)
+- :mod:`.shed` — admission control: a bounded intake queue for the
+  serving scheduler that sheds load with an explicit rejection outcome.
+
+:mod:`.chaos` is the deterministic fault-injection harness the tests
+drive; :mod:`.instruments` is the shared metric catalog (registered
+only on request, so the reference exposition stays byte-identical).
+
+Everything is opt-in: the service enables the consumer/transport story
+behind ``instance.reliability.enabled`` (see ``service.py``), the
+batcher takes an :class:`~.shed.IntakeQueue` explicitly.
+"""
+
+from .breaker import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ResilientTransport,
+)
+from .chaos import (
+    FlakyHandler,
+    FlakyTransport,
+    drop_broker_connections,
+    trip_allocator,
+)
+from .dlq import ReliableConsumer, default_dlq_topic
+from .instruments import ReliabilityMetrics
+from .policy import (
+    Deadline,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+from .shed import Admission, IntakeQueue, LoadShedError
+
+__all__ = [
+    "Admission",
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "FlakyHandler",
+    "FlakyTransport",
+    "IntakeQueue",
+    "LoadShedError",
+    "ReliabilityMetrics",
+    "ReliableConsumer",
+    "ResilientTransport",
+    "RetryBudget",
+    "RetryPolicy",
+    "current_deadline",
+    "deadline_scope",
+    "default_dlq_topic",
+    "drop_broker_connections",
+    "trip_allocator",
+]

@@ -1,0 +1,156 @@
+"""Load shedding: bounded intake for the serving scheduler.
+
+Unbounded queueing converts overload into unbounded latency and an
+eventual OOM; admission control converts it into an EXPLICIT, cheap
+rejection the caller can act on (back off, divert, degrade). This is
+the serving-side counterpart of the broker's prefetch window.
+
+:class:`IntakeQueue` is the policy object: a bounded pending queue
+(depth and, optionally, total page-cost) with an accept/shed outcome
+per offer. :class:`~beholder_tpu.models.serving.ContinuousBatcher`
+wires one in front of its schedulers (``submit()`` / ``run_pending()``)
+and reports sheds on ``beholder_serving_shed_total{reason}``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, NamedTuple
+
+from beholder_tpu.metrics import get_or_create
+
+#: shed reasons (the rejection outcome's vocabulary)
+SHED_QUEUE_FULL = "queue_full"
+SHED_COST_BACKLOG = "cost_backlog"
+SHED_OVERSIZED = "oversized"
+
+
+class Admission(NamedTuple):
+    """The explicit outcome of one intake offer."""
+
+    accepted: bool
+    reason: str | None = None  # set when shed
+
+
+class LoadShedError(RuntimeError):
+    """Raised by callers that prefer an exception to an outcome value."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"request shed: {reason}")
+        self.reason = reason
+
+
+class IntakeQueue:
+    """Bounded FIFO intake with explicit shedding.
+
+    - ``max_depth`` bounds the number of pending requests.
+    - ``max_cost`` (optional) bounds the SUM of per-request costs (the
+      serving layer uses worst-case KV pages, so backlog is bounded in
+      the resource that actually runs out, not just in count).
+    - ``cost_fn`` computes one request's cost (required with
+      ``max_cost``). A request whose own cost exceeds ``max_cost`` can
+      never be admitted and sheds as ``oversized``.
+
+    ``metrics`` (a Registry or Metrics) exports
+    ``beholder_serving_shed_total{reason}``,
+    ``beholder_serving_intake_depth``, and
+    ``beholder_serving_admitted_total``.
+    """
+
+    def __init__(
+        self,
+        max_depth: int,
+        max_cost: float | None = None,
+        cost_fn: Callable[[Any], float] | None = None,
+        metrics=None,
+    ):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if max_cost is not None and cost_fn is None:
+            raise ValueError("max_cost needs a cost_fn")
+        self.max_depth = int(max_depth)
+        self.max_cost = max_cost
+        self.cost_fn = cost_fn
+        self._lock = threading.Lock()
+        self._pending: list = []
+        self._pending_cost = 0.0
+        self.shed_counts: dict[str, int] = {}
+        self._shed_total = None
+        self._depth_gauge = None
+        self._admitted_total = None
+        if metrics is not None:
+            registry = getattr(metrics, "registry", metrics)
+            self._shed_total = get_or_create(
+                registry, "counter",
+                "beholder_serving_shed_total",
+                "Serving requests rejected at the intake queue, by reason",
+                labelnames=["reason"],
+            )
+            self._admitted_total = get_or_create(
+                registry, "counter",
+                "beholder_serving_admitted_total",
+                "Serving requests admitted through the intake queue",
+            )
+            self._depth_gauge = get_or_create(
+                registry, "gauge",
+                "beholder_serving_intake_depth",
+                "Requests waiting in the serving intake queue",
+            )
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def pending_cost(self) -> float:
+        with self._lock:
+            return self._pending_cost
+
+    # -- intake --------------------------------------------------------------
+    def _shed(self, reason: str) -> Admission:
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+        if self._shed_total is not None:
+            self._shed_total.inc(reason=reason)
+        return Admission(False, reason)
+
+    def shed(self, reason: str) -> Admission:
+        """Record an externally-decided rejection (e.g. the scheduler
+        judged the request unservable at any load) on the same counters."""
+        with self._lock:
+            return self._shed(reason)
+
+    def offer(self, item: Any, cost: float | None = None) -> Admission:
+        """Try to enqueue ``item``; never blocks, never grows past the
+        bounds — the whole point is that saying no is O(1). A caller
+        that already computed the item's cost passes it via ``cost`` to
+        skip the second ``cost_fn`` evaluation."""
+        if cost is None:
+            cost = float(self.cost_fn(item)) if self.cost_fn is not None else 0.0
+        with self._lock:
+            if self.max_cost is not None and cost > self.max_cost:
+                return self._shed(SHED_OVERSIZED)
+            if len(self._pending) >= self.max_depth:
+                return self._shed(SHED_QUEUE_FULL)
+            if (
+                self.max_cost is not None
+                and self._pending_cost + cost > self.max_cost
+            ):
+                return self._shed(SHED_COST_BACKLOG)
+            self._pending.append(item)
+            self._pending_cost += cost
+            if self._admitted_total is not None:
+                self._admitted_total.inc()
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(len(self._pending))
+            return Admission(True)
+
+    def take_all(self) -> list:
+        """Drain every pending item (the scheduler's batch pull)."""
+        with self._lock:
+            items, self._pending = self._pending, []
+            self._pending_cost = 0.0
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(0)
+            return items

@@ -13,6 +13,22 @@ preserved exactly:
 - progress consumer (index.js:127-155):
   entire body wrapped; any error warns and acks anyway — at-most-once.
 - comment helper increments beholder_trello_comments (index.js:50-58).
+
+Reliability extension (``instance.reliability.enabled``; OFF by default
+so every reference semantic above is preserved byte-for-byte):
+
+- consumers upgrade from ack-on-error/leave-unacked to AT-LEAST-ONCE
+  with a dead-letter parking lot: a failing handler nacks for
+  redelivery up to ``consumer.max_attempts`` total deliveries, then the
+  message is parked on ``<topic>.dlq`` with death-provenance headers —
+  never silently lost, never an infinite poison loop. An idempotency
+  window acks redeliveries of already-handled messages without re-running
+  side effects (effectively-once under ack loss).
+- outbound HTTP (Trello/Telegram/Emby share one transport) rides a
+  :class:`~beholder_tpu.reliability.ResilientTransport`: circuit
+  breaker (closed/open/half-open), bounded-jittered retries under a
+  shared retry budget, per-attempt timeouts capped by the configured
+  deadline. An open breaker degrades the health probe (health.py).
 """
 
 from __future__ import annotations
@@ -77,17 +93,99 @@ class BeholderService:
                 transport or RequestsTransport(), self.metrics.registry
             )
 
+        #: optional reliability subsystem (extension; off by default so
+        #: the reference's at-most-once/ack-on-error semantics and the
+        #: default exposition stay byte-identical): at-least-once
+        #: consumers with DLQ parking + dedup, and breaker/retry/deadline
+        #: armor on the shared outbound transport
+        self._at_least_once = bool(config.get("instance.reliability.enabled"))
+        self.breaker = None
+        self.reliability = None
+        self.reliable_consumers: dict[str, object] = {}
+        if self._at_least_once:
+            from beholder_tpu.reliability import (
+                CircuitBreaker,
+                ReliabilityMetrics,
+                ResilientTransport,
+                RetryBudget,
+                RetryPolicy,
+            )
+
+            if transport is None:
+                from beholder_tpu.clients.http import RequestsTransport
+
+                transport = RequestsTransport()
+
+            rel = config.get("instance.reliability") or ConfigNode({})
+            self.reliability = ReliabilityMetrics(self.metrics.registry)
+            self.breaker = CircuitBreaker(
+                name="http",
+                window=int(rel.get("breaker.window", 20)),
+                min_calls=int(rel.get("breaker.min_calls", 5)),
+                failure_threshold=float(
+                    rel.get("breaker.failure_threshold", 0.5)
+                ),
+                reset_timeout_s=float(rel.get("breaker.reset_timeout_s", 30.0)),
+                half_open_probes=int(rel.get("breaker.half_open_probes", 1)),
+                half_open_successes=int(
+                    rel.get("breaker.half_open_successes", 2)
+                ),
+                metrics=self.reliability,
+                logger=self.logger,
+            )
+            retry = RetryPolicy(
+                max_attempts=int(rel.get("retry.max_attempts", 3)),
+                base_delay_s=float(rel.get("retry.base_delay_s", 0.05)),
+                max_delay_s=float(rel.get("retry.max_delay_s", 2.0)),
+                budget=RetryBudget(
+                    capacity=float(rel.get("retry.budget_capacity", 10.0)),
+                    deposit_per_call=float(
+                        rel.get("retry.budget_per_call", 0.1)
+                    ),
+                ),
+                # the transport decides retryability per error (4xx never
+                # raises; BreakerOpenError is excluded by should_retry)
+                retry_on=(Exception,),
+                metrics=self.reliability,
+                logger=self.logger,
+            )
+            # Resilient OUTSIDE Timed: each attempt is individually timed
+            # (and timeouts get their own outcome label), while the
+            # breaker sees the attempt stream
+            transport = ResilientTransport(
+                transport,
+                breaker=self.breaker,
+                retry=retry,
+                default_deadline_s=float(
+                    config.get("instance.http.deadline_s", 10.0)
+                ),
+                logger=self.logger,
+            )
+            self._consumer_max_attempts = int(
+                rel.get("consumer.max_attempts", 3)
+            )
+            self._consumer_dedup_window = int(
+                rel.get("consumer.dedup_window", 4096)
+            )
+
+        deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),
             config.get("keys.trello.token", ""),
             transport=transport,
+            deadline_s=deadline_s,
         )
         self.telegram = TelegramClient(
-            config.get("keys.telegram.token", ""), transport=transport
+            config.get("keys.telegram.token", ""),
+            transport=transport,
+            deadline_s=deadline_s,
         )
         emby_host = config.get("instance.emby.host", "")
         self.emby = EmbyClient(
-            emby_host, config.get("keys.emby.token", ""), transport=transport
+            emby_host,
+            config.get("keys.emby.token", ""),
+            transport=transport,
+            deadline_s=deadline_s,
         )
 
         #: status-name (lowercase) -> Trello list id (index.js:60).
@@ -153,6 +251,31 @@ class BeholderService:
             # and the reference's behavior) pays zero per-message cost
             status = self._traced("telemetry.status", status)
             progress = self._traced("telemetry.progress", progress)
+        if self._at_least_once:
+            # OUTERMOST wrapper: it owns settlement on failure (nack for
+            # redelivery, park to the DLQ at the attempt cap, dedup acks
+            # on redelivered already-done messages)
+            from beholder_tpu.reliability import ReliableConsumer
+
+            status, progress = (
+                ReliableConsumer(
+                    self.broker,
+                    topic,
+                    handler,
+                    max_attempts=self._consumer_max_attempts,
+                    dedup_window=self._consumer_dedup_window,
+                    metrics=self.reliability,
+                    logger=self.logger,
+                )
+                for topic, handler in (
+                    (STATUS_TOPIC, status),
+                    (PROGRESS_TOPIC, progress),
+                )
+            )
+            self.reliable_consumers = {
+                STATUS_TOPIC: status,
+                PROGRESS_TOPIC: progress,
+            }
         self.broker.listen(STATUS_TOPIC, status)
         self.broker.listen(PROGRESS_TOPIC, progress)
         self.logger.info("initialized")
@@ -321,6 +444,11 @@ class BeholderService:
                     comment_text += f" (_{host}_)"
                 self.comment(media.creatorId, comment_text)
         except Exception as err:  # noqa: BLE001 - parity with index.js:149-152
+            if self._at_least_once:
+                # reliability mode: the error propagates to the
+                # ReliableConsumer wrapper, which nacks for redelivery or
+                # parks the message — ack-on-error would LOSE it
+                raise
             self.logger.warning(f"failed to update media progress {err}")
             return delivery.ack()
 

@@ -190,6 +190,10 @@ def bench_service() -> dict:
         "service.in_memory", "trial_wall", elapsed_trials,
         messages=N_MESSAGES,
     )
+    # schema v2: the run's reliability counters (retries/sheds/dead-
+    # lettered) ride the artifact — zero on a clean run, and a run that
+    # retried its way to a figure says so
+    artifact.record_reliability(service.metrics.registry)
     best = max(rates)
     return {
         "metrics_before": snap_before,
@@ -351,6 +355,7 @@ def bench_wire(native: bool) -> dict:
             "wire.native" if native else "wire.python", "wall",
             [elapsed], messages=n_wire,
         )
+        artifact.record_reliability(service.metrics.registry)
         return {
             "rate": n_wire / elapsed,
             "elapsed_s": elapsed,

@@ -90,6 +90,44 @@ def test_validate_rejects_malformed_artifacts():
         artifact.validate(bad)
 
 
+def test_schema_v2_requires_reliability_counters_v1_exempt():
+    good = make_recorder().to_dict()
+    assert good["reliability"] == {
+        "retries": 0.0, "sheds": 0.0, "dead_lettered": 0.0
+    }
+    bad = dict(good)
+    del bad["reliability"]
+    with pytest.raises(ValueError, match="reliability must be a dict"):
+        artifact.validate(bad)
+    bad = dict(good, reliability={"retries": "many"})
+    with pytest.raises(ValueError, match="reliability.retries"):
+        artifact.validate(bad)
+    # v1 artifacts predate the field and stay valid
+    v1 = dict(good, schema_version=1)
+    del v1["reliability"]
+    artifact.validate(v1)
+
+
+def test_record_reliability_accumulates_across_registries():
+    from beholder_tpu.metrics import Registry
+    from beholder_tpu.reliability import ReliabilityMetrics
+
+    rec = artifact.ArtifactRecorder("bench_rel")
+    reg1 = Registry()
+    m1 = ReliabilityMetrics(reg1)
+    m1.retry_attempts_total.inc(op="http.get")
+    m1.retry_attempts_total.inc(op="consume.t")
+    m1.dead_lettered_total.inc(queue="q", reason="max-retries")
+    rec.record_reliability(reg1)
+    reg2 = Registry()  # a second section's registry: sums accumulate
+    ReliabilityMetrics(reg2).retry_attempts_total.inc(op="http.get")
+    rec.record_reliability(reg2)
+    rec.record_reliability(Registry())  # series absent: contributes zero
+    out = rec.to_dict()["reliability"]
+    assert out == {"retries": 3.0, "sheds": 0.0, "dead_lettered": 1.0}
+    artifact.validate(rec.to_dict())
+
+
 def test_section_snapshots_result_against_later_mutation():
     """bench call sites keep assembling the dict they passed to section()
     (``accel["flash"] = ...``); the stored section must not grow with it."""

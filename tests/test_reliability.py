@@ -1,0 +1,663 @@
+"""Reliability subsystem: retry jitter/budget bounds, deadline
+propagation, breaker state transitions, resilient transport behavior,
+at-least-once consumers with DLQ parking + dedup, and serving-intake
+load shedding."""
+
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from beholder_tpu.clients.http import (
+    HttpError,
+    HttpResponse,
+    RecordingTransport,
+    TimedTransport,
+)
+from beholder_tpu.metrics import Metrics, Registry
+from beholder_tpu.mq import InMemoryBroker
+from beholder_tpu.reliability import (
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    FlakyHandler,
+    FlakyTransport,
+    IntakeQueue,
+    ReliabilityMetrics,
+    ReliableConsumer,
+    ResilientTransport,
+    RetryBudget,
+    RetryPolicy,
+    current_deadline,
+    deadline_scope,
+)
+
+# -- retry policy ------------------------------------------------------------
+
+
+def test_backoff_full_jitter_bounds():
+    """Full jitter: uniform over [0, min(cap, base * mult**(n-1)))."""
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=0.1, max_delay_s=1.0, multiplier=2.0,
+        rng=lambda: 0.999999,
+    )
+    # caps: 0.1, 0.2, 0.4, 0.8, then clipped at max_delay 1.0
+    for attempt, cap in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.8), (5, 1.0)):
+        assert policy.backoff_s(attempt) <= cap
+        assert policy.backoff_s(attempt) > 0.99 * cap
+    zero = RetryPolicy(rng=lambda: 0.0)
+    assert zero.backoff_s(1) == 0.0  # jitter reaches all the way down
+
+
+def test_retry_succeeds_after_transient_failures_and_counts():
+    metrics = ReliabilityMetrics(Registry())
+    sleeps = []
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.01, metrics=metrics,
+        sleep=sleeps.append, rng=lambda: 0.5,
+    )
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert policy.call(flaky, op="unit") == "ok"
+    assert calls["n"] == 3
+    assert len(sleeps) == 2
+    assert metrics.retry_attempts_total.value(op="unit") == 2
+
+
+def test_retry_gives_up_after_max_attempts():
+    metrics = ReliabilityMetrics(Registry())
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0, metrics=metrics, sleep=lambda s: None
+    )
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.call(always, op="unit")
+    assert calls["n"] == 3
+    assert metrics.retry_give_ups_total.value(op="unit", reason="attempts") == 1
+
+
+def test_retry_budget_denies_when_drained():
+    """The retry-storm guard: an empty bucket fails fast instead of
+    multiplying offered load by max_attempts."""
+    budget = RetryBudget(capacity=2.0, deposit_per_call=0.0)
+    metrics = ReliabilityMetrics(Registry())
+    policy = RetryPolicy(
+        max_attempts=10, base_delay_s=0, budget=budget, metrics=metrics,
+        sleep=lambda s: None,
+    )
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        # burns both tokens, then the 3rd attempt is denied by budget
+        policy.call(always, op="unit")
+    assert budget.tokens == 0.0
+    assert metrics.retry_give_ups_total.value(op="unit", reason="budget") == 1
+    calls = {"n": 0}
+
+    def count_and_fail():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.call(count_and_fail, op="unit")
+    assert calls["n"] == 1  # no retry granted at all
+    assert metrics.retry_give_ups_total.value(op="unit", reason="budget") == 2
+
+
+def test_retry_budget_deposits_refill_capped():
+    budget = RetryBudget(capacity=1.5, deposit_per_call=0.5)
+    assert budget.try_spend()
+    assert not budget.try_spend()  # 0.5 < 1 token
+    budget.record_call()  # -> 1.0
+    assert budget.try_spend()
+    for _ in range(10):
+        budget.record_call()
+    assert budget.tokens == 1.5  # capped
+
+
+# -- deadlines ---------------------------------------------------------------
+
+
+def test_deadline_cap_and_expiry():
+    t = {"now": 100.0}
+    d = Deadline.after(2.0, clock=lambda: t["now"])
+    assert d.cap(10.0) == pytest.approx(2.0)
+    assert d.cap(1.0) == pytest.approx(1.0)
+    t["now"] = 103.0
+    assert d.expired
+    with pytest.raises(DeadlineExceeded):
+        d.cap(1.0)
+
+
+def test_deadline_scope_propagates_and_keeps_tighter():
+    assert current_deadline() is None
+    with deadline_scope(10.0) as outer:
+        assert current_deadline() is outer
+        with deadline_scope(5.0) as inner:
+            assert inner is not outer
+            assert current_deadline().remaining() <= 5.0
+        with deadline_scope(100.0) as widened:
+            # an inner scope may shrink the budget, never extend it
+            assert widened is outer
+        assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+def test_retry_respects_deadline_instead_of_sleeping_past_it():
+    metrics = ReliabilityMetrics(Registry())
+    t = {"now": 0.0}
+    deadline = Deadline.after(0.05, clock=lambda: t["now"])
+    policy = RetryPolicy(
+        max_attempts=10, base_delay_s=1.0, metrics=metrics,
+        sleep=lambda s: None, rng=lambda: 0.9,
+    )
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        policy.call(always, op="unit", deadline=deadline)
+    assert metrics.retry_give_ups_total.value(op="unit", reason="deadline") == 1
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def _clocked_breaker(**kw):
+    t = {"now": 0.0}
+    metrics = ReliabilityMetrics(Registry())
+    defaults = dict(
+        name="b", window=10, min_calls=4, failure_threshold=0.5,
+        reset_timeout_s=5.0, half_open_probes=1, half_open_successes=2,
+        clock=lambda: t["now"], metrics=metrics,
+    )
+    defaults.update(kw)
+    return CircuitBreaker(**defaults), t, metrics
+
+
+def test_breaker_full_cycle_closed_open_half_open_closed():
+    b, t, metrics = _clocked_breaker()
+    # under min_calls: failures alone cannot trip it
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # 4 calls, 100% failure -> open
+    assert b.state == "open"
+    assert not b.allow()  # rejected without touching the dependency
+    assert b.retry_after_s() > 0
+
+    t["now"] = 5.1  # cooldown elapsed: next allow() becomes the probe
+    assert b.allow()
+    assert b.state == "half_open"
+    assert not b.allow()  # only one concurrent probe admitted
+    b.record_success()
+    assert b.state == "half_open"  # needs 2 successes
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    assert b.failure_rate() == 0.0  # window reset on close
+
+    gauge = metrics.breaker_state
+    assert gauge.value(breaker="b") == 0
+    trans = metrics.breaker_transitions_total
+    assert trans.value(breaker="b", state="open") == 1
+    assert trans.value(breaker="b", state="half_open") == 1
+    assert trans.value(breaker="b", state="closed") == 1
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    b, t, _ = _clocked_breaker()
+    for _ in range(4):
+        b.record_failure()
+    t["now"] = 5.1
+    assert b.allow()
+    b.record_failure()  # sick dependency still sick
+    assert b.state == "open"
+    assert not b.allow()  # new cooldown started at t=5.1
+    t["now"] = 10.3
+    assert b.allow()
+    b.record_success()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_windowed_rate_mixed_outcomes():
+    b, _, _ = _clocked_breaker(window=4, min_calls=4, failure_threshold=0.75)
+    for _ in range(4):
+        b.record_success()
+    # window slides: 3 failures in the last 4 outcomes = 75% -> open
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+
+
+def test_breaker_call_wrapper_and_rejection_metric():
+    b, _, metrics = _clocked_breaker(min_calls=2)
+    with pytest.raises(ValueError):
+        b.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    with pytest.raises(ValueError):
+        b.call(lambda: (_ for _ in ()).throw(ValueError("x")))
+    assert b.state == "open"
+    with pytest.raises(BreakerOpenError):
+        b.call(lambda: "never runs")
+    assert metrics.breaker_rejections_total.value(breaker="b") == 1
+
+
+# -- resilient transport -----------------------------------------------------
+
+
+def _resilient(inner, **kw):
+    kw.setdefault(
+        "retry",
+        RetryPolicy(max_attempts=3, base_delay_s=0, sleep=lambda s: None),
+    )
+    kw.setdefault("breaker", CircuitBreaker(name="t", min_calls=50))
+    return ResilientTransport(inner, **kw)
+
+
+def test_resilient_transport_retries_transport_faults():
+    inner = RecordingTransport()
+    flaky = FlakyTransport(inner)
+    flaky.fail_next(2, exc=ConnectionError("boom"))
+    t = _resilient(flaky)
+    resp = t.request("get", "http://x/a")
+    assert resp.status == 200
+    assert flaky.requests_seen == 3
+    assert len(inner.requests) == 1  # only the success reached the wire
+
+
+def test_resilient_transport_retries_5xx_and_returns_final_response():
+    inner = RecordingTransport()
+    flaky = FlakyTransport(inner)
+    flaky.fail_next(5, status=503)
+    t = _resilient(flaky)
+    resp = t.request("get", "http://x/a")
+    assert resp.status == 503  # exhausted retries: response returned,
+    assert flaky.requests_seen == 3  # client owns raise_for_status
+    with pytest.raises(HttpError):
+        resp.raise_for_status()
+
+
+def test_resilient_transport_does_not_retry_4xx():
+    inner = RecordingTransport()
+    inner.responses.append(HttpResponse(status=404, body={}))
+    t = _resilient(inner)
+    resp = t.request("get", "http://x/a")
+    assert resp.status == 404
+    assert len(inner.requests) == 1
+
+
+def test_resilient_transport_breaker_opens_and_fast_fails():
+    inner = RecordingTransport()
+    flaky = FlakyTransport(inner)
+    flaky.fail_predicate = lambda m, u: True  # hard down
+    # min_calls == max_attempts: the breaker opens as the LAST retry
+    # fails, so the first request surfaces the real transport error and
+    # the second fast-fails
+    breaker = CircuitBreaker(name="t", window=4, min_calls=3)
+    t = _resilient(flaky, breaker=breaker)
+    with pytest.raises(ConnectionError):
+        t.request("get", "http://x/a")
+    assert breaker.state == "open"
+    seen = flaky.requests_seen
+    with pytest.raises(BreakerOpenError):
+        t.request("get", "http://x/a")
+    assert flaky.requests_seen == seen  # fast fail: dependency untouched
+
+
+def test_resilient_transport_deadline_caps_attempt_timeout():
+    seen = []
+
+    class Probe(RecordingTransport):
+        def request(self, method, url, *, params=None, json=None, timeout=10.0):
+            seen.append(timeout)
+            return super().request(
+                method, url, params=params, json=json, timeout=timeout
+            )
+
+    t = _resilient(Probe(), default_deadline_s=0.5)
+    t.request("get", "http://x/a", timeout=10.0)
+    assert seen and seen[0] <= 0.5
+    with deadline_scope(0.05):
+        t.request("get", "http://x/a", timeout=10.0)
+    assert seen[-1] <= 0.05
+
+
+def test_expired_deadline_cannot_leak_a_half_open_probe_slot():
+    """Regression: an expired deadline raising between breaker admission
+    and the attempt must not consume the (single) half-open probe slot —
+    that would wedge the breaker half-open forever (no time-based
+    escape) and fast-fail all outbound traffic until restart."""
+    t = {"now": 0.0}
+    breaker = CircuitBreaker(
+        name="t", window=4, min_calls=2, reset_timeout_s=1.0,
+        half_open_probes=1, half_open_successes=1, clock=lambda: t["now"],
+    )
+    inner = RecordingTransport()
+    transport = _resilient(inner, breaker=breaker)
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "open"
+    t["now"] = 1.1  # cooldown elapsed: the next admitted call is a probe
+    with deadline_scope(Deadline(0.0)):  # already expired
+        with pytest.raises(DeadlineExceeded):
+            transport.request("get", "http://x/a")
+    assert inner.requests == []  # never reached the dependency
+    # the probe slot was NOT consumed: a healthy call can still probe
+    # through and close the breaker
+    resp = transport.request("get", "http://x/b")
+    assert resp.status == 200
+    assert breaker.state == "closed"
+
+
+def test_timed_transport_labels_timeouts_distinctly():
+    metrics = Metrics()
+    inner = RecordingTransport()
+    t = TimedTransport(inner, metrics)
+    inner.fail_with = TimeoutError("deadline")
+    with pytest.raises(TimeoutError):
+        t.request("get", "http://x/a")
+    inner.fail_with = OSError("conn reset")
+    with pytest.raises(OSError):
+        t.request("get", "http://x/b")
+    h = metrics.registry.find("beholder_http_request_seconds")
+    assert h.count(method="GET", outcome="timeout") == 1
+    assert h.count(method="GET", outcome="error") == 1
+
+
+# -- at-least-once consumer + DLQ --------------------------------------------
+
+
+def _consumer_rig(handler, **kw):
+    broker = InMemoryBroker()
+    broker.connect()
+    metrics = ReliabilityMetrics(Registry())
+    consumer = ReliableConsumer(
+        broker, "t", handler, metrics=metrics, **kw
+    )
+    broker.listen("t", consumer)
+    parked = []
+    broker.listen(
+        "t.dlq", lambda d: (parked.append(d), d.ack())
+    )
+    return broker, consumer, metrics, parked
+
+
+def test_poison_message_parks_on_dlq_after_max_attempts():
+    attempts = []
+
+    def poison(delivery):
+        attempts.append(delivery.delivery_count)
+        raise RuntimeError("handler down")
+
+    broker, consumer, metrics, parked = _consumer_rig(poison, max_attempts=3)
+    broker.publish("t", b"poison", headers={"k": "v"})
+    assert attempts == [0, 1, 2]  # broker-stamped x-delivery-count
+    assert broker.in_flight == 0  # settled: nothing stuck
+    assert consumer.parked == 1
+    (dead,) = parked
+    assert dead.body == b"poison"
+    assert dead.headers["x-beholder-death-queue"] == "t"
+    assert dead.headers["x-beholder-death-reason"] == "max-retries"
+    assert dead.headers["x-beholder-death-attempts"] == 3
+    assert dead.headers["k"] == "v"  # original headers preserved
+    assert metrics.dead_lettered_total.value(queue="t", reason="max-retries") == 1
+    assert metrics.retry_attempts_total.value(op="consume.t") == 2
+
+
+def test_transient_failure_redelivers_then_handles():
+    handled = []
+    flaky = FlakyHandler(
+        lambda d: (handled.append(d.redelivered), d.ack()), fail_times=2
+    )
+    broker, consumer, metrics, parked = _consumer_rig(flaky, max_attempts=5)
+    broker.publish("t", b"msg")
+    assert handled == [True]  # succeeded on a redelivery
+    assert parked == []
+    assert consumer.parked == 0
+
+
+def test_dedup_acks_redelivery_of_already_handled_message():
+    """Effectively-once: a redelivery of a message whose handler already
+    succeeded (ack lost) must not re-run side effects."""
+    runs = []
+
+    def handler(delivery):
+        runs.append(delivery.body)
+        delivery.ack()
+
+    broker = InMemoryBroker()
+    broker.connect()
+    metrics = ReliabilityMetrics(Registry())
+    consumer = ReliableConsumer(broker, "t", handler, metrics=metrics)
+    broker.listen("t", consumer)
+    broker.publish("t", b"m1")
+    assert runs == [b"m1"]
+
+    # simulate the broker redelivering after a lost ack
+    settled = []
+    from beholder_tpu.mq.base import Delivery
+
+    redelivery = Delivery(
+        "t", b"m1", 99,
+        lambda tag, acked, requeue: settled.append((acked, requeue)),
+        redelivered=True,
+    )
+    consumer(redelivery)
+    assert runs == [b"m1"]  # handler NOT re-run
+    assert settled == [(True, False)]  # but the redelivery was acked
+    assert metrics.dedup_hits_total.value(topic="t") == 1
+
+    # a FRESH identical publish is new work, not a duplicate
+    broker.publish("t", b"m1")
+    assert runs == [b"m1", b"m1"]
+
+
+def test_identical_fresh_messages_both_run():
+    runs = []
+    broker, _, _, _ = _consumer_rig(
+        lambda d: (runs.append(1), d.ack())
+    )
+    broker.publish("t", b"same")
+    broker.publish("t", b"same")
+    assert len(runs) == 2
+
+
+def test_memory_broker_routes_rejects_to_dlq():
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.set_dead_letter("q", "q.dead")
+    dead = []
+    broker.listen("q", lambda d: d.nack(requeue=False))
+    broker.listen("q.dead", lambda d: (dead.append(d), d.ack()))
+    broker.publish("q", b"x", headers={"a": 1})
+    assert len(dead) == 1
+    assert dead[0].body == b"x"
+    assert dead[0].headers["x-beholder-death-reason"] == "rejected"
+    assert dead[0].headers["a"] == 1
+    assert broker.dead_lettered[("q", "rejected")] == 1
+
+
+def test_memory_broker_stamps_delivery_count_on_requeue():
+    counts = []
+
+    def handler(d):
+        counts.append((d.redelivered, d.delivery_count))
+        if len(counts) < 3:
+            d.nack(requeue=True)
+        else:
+            d.ack()
+
+    broker = InMemoryBroker()
+    broker.connect()
+    broker.listen("q", handler)
+    broker.publish("q", b"x")
+    assert counts == [(False, 0), (True, 1), (True, 2)]
+
+
+# -- serving intake / load shedding ------------------------------------------
+
+
+def test_intake_queue_sheds_with_explicit_reasons():
+    registry = Registry()
+    q = IntakeQueue(
+        max_depth=2, max_cost=10, cost_fn=lambda item: item, metrics=registry
+    )
+    assert q.offer(4).accepted
+    assert q.offer(4).accepted
+    shed = q.offer(1)
+    assert (shed.accepted, shed.reason) == (False, "queue_full")
+    assert q.take_all() == [4, 4]
+    assert q.offer(11) == (False, "oversized")
+    assert q.offer(8).accepted
+    assert q.offer(8) == (False, "cost_backlog")
+    text = registry.render()
+    assert 'beholder_serving_shed_total{reason="queue_full"} 1' in text
+    assert 'beholder_serving_shed_total{reason="oversized"} 1' in text
+    assert 'beholder_serving_shed_total{reason="cost_backlog"} 1' in text
+    assert "beholder_serving_admitted_total 3" in text
+
+
+def _mk_batcher(**kwargs):
+    import jax
+
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return ContinuousBatcher(
+        model, state.params, num_pages=16, page_size=8, slots=2,
+        max_prefix=16, max_pages_per_seq=4, **kwargs,
+    )
+
+
+def _request(seed, t=9, horizon=4):
+    import numpy as np
+
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+    )
+
+
+def test_batcher_bounded_intake_sheds_under_load_and_serves_admitted():
+    metrics = Metrics()
+    batcher = _mk_batcher(metrics=metrics, max_pending=2)
+    outcomes = [batcher.submit(_request(i)) for i in range(4)]
+    assert [o.accepted for o in outcomes] == [True, True, False, False]
+    assert {o.reason for o in outcomes[2:]} == {"queue_full"}
+    assert batcher.intake.depth == 2
+
+    results = batcher.run_pending()
+    assert len(results) == 2
+    assert all(r.shape == (4,) for r in results)
+    assert batcher.intake.depth == 0
+    assert batcher.run_pending() == []  # drained
+
+    # an unservable request sheds as oversized instead of poisoning a run
+    big = _request(0, t=9, horizon=200)
+    assert batcher.submit(big) == (False, "oversized")
+    text = metrics.registry.render()
+    assert 'beholder_serving_shed_total{reason="queue_full"} 2' in text
+    assert 'beholder_serving_shed_total{reason="oversized"} 1' in text
+
+
+def test_chaos_trip_allocator_surfaces_error_and_poisons():
+    from beholder_tpu.reliability.chaos import trip_allocator
+
+    batcher = _mk_batcher()
+    trip_allocator(batcher)
+    with pytest.raises(RuntimeError, match="pool exhausted"):
+        batcher.run_waves([_request(0)])
+    with pytest.raises(RuntimeError, match="fresh ContinuousBatcher"):
+        batcher.run_waves([_request(1)])
+
+
+# -- health integration ------------------------------------------------------
+
+
+def test_open_breaker_degrades_health_probe():
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.health import health_from_config
+    from beholder_tpu.storage import MemoryStorage
+
+    breaker = CircuitBreaker(name="http", window=4, min_calls=2)
+    service = types.SimpleNamespace(
+        broker=types.SimpleNamespace(connected=True),
+        db=MemoryStorage(),
+        breaker=breaker,
+    )
+    config = ConfigNode({"instance": {"health": {"enabled": True, "port": 0}}})
+    server = health_from_config(config, service)
+    try:
+        healthy, checks = server.snapshot()
+        assert healthy and checks["breaker"]["detail"] == "closed"
+
+        breaker.record_failure()
+        breaker.record_failure()
+        assert breaker.state == "open"
+        healthy, checks = server.snapshot()
+        assert not healthy
+        assert not checks["breaker"]["ok"]
+        assert "open" in checks["breaker"]["detail"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz"
+        ) as resp:  # pragma: no cover - only runs if probe wrongly passes
+            raise AssertionError(f"expected 503, got {resp.status}")
+    except urllib.error.HTTPError as err:
+        assert err.code == 503
+    finally:
+        server.close()
+
+
+def test_service_reliability_disabled_keeps_reference_semantics():
+    """The gate: with reliability off (the default), the progress
+    consumer still acks on error (at-most-once parity) and no
+    reliability series exist."""
+    from beholder_tpu import proto
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.service import PROGRESS_TOPIC, BeholderService
+    from beholder_tpu.storage import MemoryStorage
+
+    broker = InMemoryBroker()
+    service = BeholderService(
+        ConfigNode({"keys": {"trello": {"key": "K", "token": "T"}}}),
+        broker,
+        MemoryStorage(),
+        transport=RecordingTransport(),
+    )
+    service.start()
+    assert service.breaker is None
+    # missing media row -> handler error -> warn and ack anyway
+    broker.publish(
+        PROGRESS_TOPIC,
+        proto.encode(
+            proto.TelemetryProgress(mediaId="ghost", status=0, progress=1)
+        ),
+    )
+    assert broker.in_flight == 0
+    text = service.metrics.registry.render()
+    assert "beholder_retry_attempts_total" not in text
+    assert "beholder_dead_lettered_total" not in text
