@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench lint clean
+.PHONY: all proto native test bench bench-cache lint clean
 
 all: proto native
 
@@ -37,6 +37,13 @@ test:
 
 bench:
 	python bench.py
+
+# the caching scenario alone: replay a shared-prefix request mix cold
+# then warm, report the warm/cold prefill-token ratio (writes
+# artifacts/bench_cache.json; the full `make bench` run carries the
+# same scenario inside bench_e2e.json)
+bench-cache:
+	python bench.py --cache-only
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -33,7 +33,10 @@ Schema (``validate`` is the authoritative checker)::
       "raw_timings": [{"label": ..., "method": ..., "samples_s": [...],
                        ...extra}],
       "reliability": {"retries": 0.0, "sheds": 0.0,
-                      "dead_lettered": 0.0}   # v2: reliability counters
+                      "dead_lettered": 0.0},  # v2: reliability counters
+      "cache": {"prefix_hits": 0.0, "prefix_misses": 0.0,
+                "cached_pages": 0.0, "evictions": 0.0,
+                "singleflight_collapsed": 0.0}  # v3: cache counters
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -42,6 +45,14 @@ intake, messages dead-lettered — summed across the run's registries
 (:meth:`ArtifactRecorder.record_reliability`). A bench run that
 silently retried its way to a headline figure now says so in the
 artifact. v1 artifacts (no ``reliability`` key) remain valid.
+
+Schema v3 (the caching PR): the run's cache counters ride along the
+same way (:meth:`ArtifactRecorder.record_cache`) — prefix-cache
+hits/misses/evictions, pages resident at snapshot time, and
+singleflight collapses across every keyed cache. A headline figure that
+leaned on warm caches now says so; the bench-cache scenario's warm/cold
+prefill ratio is backed by these counters. v1/v2 artifacts remain
+valid.
 """
 
 from __future__ import annotations
@@ -53,7 +64,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 #: artifact key -> the counter family summed into it (across labels)
 RELIABILITY_COUNTERS = {
@@ -61,6 +72,25 @@ RELIABILITY_COUNTERS = {
     "sheds": "beholder_serving_shed_total",
     "dead_lettered": "beholder_dead_lettered_total",
 }
+
+#: v3: artifact key -> the cache counter family summed into it. The
+#: prefix-cache eviction and core-cache eviction series both fold into
+#: ``evictions`` (one "pages/entries dropped under pressure" figure).
+CACHE_COUNTERS = {
+    "prefix_hits": ("beholder_prefix_cache_hits_total",),
+    "prefix_misses": ("beholder_prefix_cache_misses_total",),
+    "evictions": (
+        "beholder_prefix_cache_evictions_total",
+        "beholder_cache_evictions_total",
+    ),
+    "singleflight_collapsed": (
+        "beholder_cache_singleflight_collapsed_total",
+    ),
+}
+
+#: v3: the snapshot gauge — pages resident in the prefix cache when the
+#: registry was recorded (latest snapshot wins, not a sum)
+CACHE_PAGES_GAUGE = "beholder_prefix_cache_cached_pages"
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
@@ -126,6 +156,10 @@ class ArtifactRecorder:
         self.reliability: dict[str, float] = {
             key: 0.0 for key in RELIABILITY_COUNTERS
         }
+        self.cache: dict[str, float] = {
+            key: 0.0 for key in CACHE_COUNTERS
+        }
+        self.cache["cached_pages"] = 0.0
 
     def section(
         self,
@@ -178,6 +212,27 @@ class ArtifactRecorder:
             if counter is not None:
                 self.reliability[key] += float(counter.total())
 
+    def record_cache(self, registry) -> None:
+        """Accumulate one registry's cache counters (prefix hits/misses,
+        evictions, singleflight collapses; ``cached_pages`` takes the
+        registry's current gauge value — a snapshot, not a sum). Same
+        accumulate-across-registries contract as
+        :meth:`record_reliability`."""
+        find = getattr(registry, "find", None)
+        if find is None:  # a Metrics wrapper
+            registry = getattr(registry, "registry", None)
+            find = getattr(registry, "find", None)
+            if find is None:
+                return
+        for key, names in CACHE_COUNTERS.items():
+            for name in names:
+                counter = find(name)
+                if counter is not None:
+                    self.cache[key] += float(counter.total())
+        gauge = find(CACHE_PAGES_GAUGE)
+        if gauge is not None:
+            self.cache["cached_pages"] = float(gauge.value())
+
     def to_dict(self) -> dict[str, Any]:
         outcome = "ok"
         if self.error is not None:
@@ -197,6 +252,7 @@ class ArtifactRecorder:
             "sections": self.sections,
             "raw_timings": self.raw,
             "reliability": dict(self.reliability),
+            "cache": dict(self.cache),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -240,6 +296,13 @@ def record_reliability(registry) -> None:
     recorder; no-op without one (same contract as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_reliability(registry)
+
+
+def record_cache(registry) -> None:
+    """Accumulate a registry's cache counters into the active recorder;
+    no-op without one (same contract as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_cache(registry)
 
 
 # -- validation ---------------------------------------------------------------
@@ -290,6 +353,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"reliability.{key} must be a number, "
                         f"got {rel.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 3:
+        # v3: cache counters are part of the evidence
+        cache = obj.get("cache")
+        if not isinstance(cache, dict):
+            problems.append("cache must be a dict (schema v3+)")
+        else:
+            for key in (*CACHE_COUNTERS, "cached_pages"):
+                if not isinstance(cache.get(key), (int, float)):
+                    problems.append(
+                        f"cache.{key} must be a number, "
+                        f"got {cache.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

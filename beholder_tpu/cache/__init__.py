@@ -1,0 +1,45 @@
+"""Caching subsystem: a shared keyed-cache core + the serving layer's
+automatic prefix cache.
+
+- :mod:`.core` — policy-pluggable (LRU/LFU/TTL) keyed cache with
+  byte/entry capacity accounting, singleflight duplicate-load collapse,
+  and explicit writer-side invalidation. Used by the storage query
+  cache (:mod:`beholder_tpu.storage.cached`), the outbound HTTP lookup
+  cache (:class:`beholder_tpu.clients.http.CachingTransport`), and the
+  read-only endpoint response cache
+  (:class:`beholder_tpu.httpd.CachedRoute`).
+- :mod:`.prefix` — radix (chained page hash) index mapping admitted
+  token prefixes to KV pool pages; the host half of vLLM-style
+  automatic prefix caching for
+  :class:`beholder_tpu.models.serving.ContinuousBatcher`.
+- :mod:`.instruments` — the metric catalog, registered only on demand
+  so the pinned default exposition stays byte-identical.
+
+Everything here is opt-in: no service or batcher constructs a cache
+unless configured to (``instance.cache.*`` / ``prefix_cache=``), and
+with caching off behavior is byte-identical to the uncached paths.
+"""
+
+from .core import (
+    EvictionPolicy,
+    KeyedCache,
+    LFUPolicy,
+    LRUPolicy,
+    SingleFlight,
+    TTLPolicy,
+)
+from .instruments import CacheMetrics, PrefixCacheMetrics
+from .prefix import PrefixCache, page_hashes
+
+__all__ = [
+    "KeyedCache",
+    "SingleFlight",
+    "EvictionPolicy",
+    "LRUPolicy",
+    "LFUPolicy",
+    "TTLPolicy",
+    "PrefixCache",
+    "page_hashes",
+    "CacheMetrics",
+    "PrefixCacheMetrics",
+]

@@ -33,3 +33,18 @@ class EmbyClient:
         )
         resp.raise_for_status()
         return resp
+
+    def library_folders(self) -> HttpResponse:
+        """GET /emby/Library/VirtualFolders — the read-only library
+        listing. Unlike :meth:`refresh_library` (a GET with a side
+        effect, never cacheable) this is a pure lookup, TTL-cached by
+        the service's :class:`~beholder_tpu.clients.http
+        .CachingTransport` (``instance.cache.http``)."""
+        resp = self._transport.request(
+            "get",
+            f"{self._host}/emby/Library/VirtualFolders",
+            params={"api_key": self._token},
+            timeout=self._deadline_s,
+        )
+        resp.raise_for_status()
+        return resp

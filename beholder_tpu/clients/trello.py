@@ -53,6 +53,18 @@ class TrelloClient:
             "put", f"/1/cards/{card_id}", {"idList": list_id, "pos": pos}
         )
 
+    def get_board(self, board_id: str) -> HttpResponse:
+        """GET /1/boards/<id> — a read-only lookup (board metadata, list
+        layout). Hot when resolving flow lists for many cards; the
+        service's :class:`~beholder_tpu.clients.http.CachingTransport`
+        TTL-caches it (``instance.cache.http``)."""
+        return self.make_request("get", f"/1/boards/{board_id}")
+
+    def get_card(self, card_id: str) -> HttpResponse:
+        """GET /1/cards/<id> — read-only card lookup (same cache tier
+        as :meth:`get_board`)."""
+        return self.make_request("get", f"/1/cards/{card_id}")
+
     def comment_card(self, card_id: str, text: str) -> HttpResponse:
         """POST a comment action; empty text falls back like index.js:54."""
         return self.make_request(

@@ -418,10 +418,20 @@ class Metrics:
         )
         self._server: ThreadingHTTPServer | None = None
 
-    def expose(self, port: int | None = None) -> int:
+    def expose(
+        self, port: int | None = None, cache_max_age_s: float | None = None
+    ) -> int:
         """Start the /metrics endpoint (``Prom.expose()``, index.js:28).
 
         Returns the bound port (pass 0 for an ephemeral one in tests).
+
+        ``cache_max_age_s`` (cache subsystem; the service threads
+        ``instance.cache.httpd.metrics_max_age_s`` here) memoizes the
+        rendered exposition for that window and serves it with
+        ``Cache-Control``/``ETag`` (304 on revalidation) — under
+        scrape storms the registry renders once per window, not once
+        per request. None (the default) keeps the uncached behavior
+        byte-identical.
         """
         if port is None:
             port = int(os.environ.get("METRICS_PORT", DEFAULT_PORT))
@@ -430,7 +440,12 @@ class Metrics:
         def render():
             return 200, CONTENT_TYPE, registry.render().encode()
 
-        self._server = serve_routes({"/metrics": render, "/": render}, port)
+        route = render
+        if cache_max_age_s is not None:
+            from beholder_tpu.httpd import CachedRoute
+
+            route = CachedRoute(render, cache_max_age_s)
+        self._server = serve_routes({"/metrics": route, "/": route}, port)
         return self._server.server_address[1]
 
     def close(self) -> None:

@@ -218,12 +218,22 @@ class Block(nn.Module):
                         )
                     live = live[:, None, None, None, :]
                 else:
-                    live = positions <= index
+                    # scalar index: positions index..index+t-1 are being
+                    # decoded this call. t == 1 is the classic decode
+                    # step; t > 1 is a CHUNKED continuation — e.g. the
+                    # prefix cache's suffix prefill on top of cached
+                    # context — causal WITHIN the chunk (query j sees
+                    # cache positions <= index + j)
+                    pos_q = index + jnp.arange(t)
+                    live = positions[None, :] <= pos_q[:, None]  # (t, L)
                     if self.window is not None:
-                        # decode position ``index`` sees the previous
+                        # each decoded position sees the previous
                         # ``window`` cache slots, matching the training
                         # band
-                        live = live & (positions > index - self.window)
+                        live = live & (
+                            positions[None, :] > pos_q[:, None] - self.window
+                        )
+                    live = live[None, None, None, :, :]
                 scores = jnp.where(live, scores, -1e30)
                 weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
                 att = jnp.einsum(

@@ -14,10 +14,14 @@ and reports sheds on ``beholder_serving_shed_total{reason}``.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any, Callable, NamedTuple
 
 from beholder_tpu.metrics import get_or_create
+
+#: per-process counter behind IntakeQueue's default names
+_default_names = itertools.count()
 
 #: shed reasons (the rejection outcome's vocabulary)
 SHED_QUEUE_FULL = "queue_full"
@@ -53,8 +57,13 @@ class IntakeQueue:
 
     ``metrics`` (a Registry or Metrics) exports
     ``beholder_serving_shed_total{reason}``,
-    ``beholder_serving_intake_depth``, and
-    ``beholder_serving_admitted_total``.
+    ``beholder_serving_intake_depth``,
+    ``beholder_serving_admitted_total``, and — naming this queue via
+    ``name`` — the LABELLED ``beholder_intake_queue_depth{queue}``
+    series, the serving-side counterpart of the broker's per-queue
+    ``beholder_mq_queue_depth{queue}`` (PR 1 instrumented MQ depth but
+    left the serving intake path an unlabelled singleton; multiple
+    intakes in one process now chart side by side).
     """
 
     def __init__(
@@ -63,20 +72,30 @@ class IntakeQueue:
         max_cost: float | None = None,
         cost_fn: Callable[[Any], float] | None = None,
         metrics=None,
+        name: str | None = None,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if name is None:
+            # default names stay unique per process: two unnamed queues
+            # sharing a registry must not silently overwrite each
+            # other's depth series (the first keeps the bare name so the
+            # common single-queue case charts stably)
+            n = next(_default_names)
+            name = "serving.intake" if n == 0 else f"serving.intake-{n + 1}"
         if max_cost is not None and cost_fn is None:
             raise ValueError("max_cost needs a cost_fn")
         self.max_depth = int(max_depth)
         self.max_cost = max_cost
         self.cost_fn = cost_fn
+        self.name = name
         self._lock = threading.Lock()
         self._pending: list = []
         self._pending_cost = 0.0
         self.shed_counts: dict[str, int] = {}
         self._shed_total = None
         self._depth_gauge = None
+        self._labelled_depth = None
         self._admitted_total = None
         if metrics is not None:
             registry = getattr(metrics, "registry", metrics)
@@ -96,6 +115,14 @@ class IntakeQueue:
                 "beholder_serving_intake_depth",
                 "Requests waiting in the serving intake queue",
             )
+            self._labelled_depth = get_or_create(
+                registry, "gauge",
+                "beholder_intake_queue_depth",
+                "Requests waiting in a bounded intake queue, by queue "
+                "name (serving-side twin of beholder_mq_queue_depth)",
+                labelnames=["queue"],
+            )
+            self._labelled_depth.set(0, queue=self.name)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -144,6 +171,8 @@ class IntakeQueue:
                 self._admitted_total.inc()
             if self._depth_gauge is not None:
                 self._depth_gauge.set(len(self._pending))
+            if self._labelled_depth is not None:
+                self._labelled_depth.set(len(self._pending), queue=self.name)
             return Admission(True)
 
     def take_all(self) -> list:
@@ -153,4 +182,6 @@ class IntakeQueue:
             self._pending_cost = 0.0
             if self._depth_gauge is not None:
                 self._depth_gauge.set(0)
+            if self._labelled_depth is not None:
+                self._labelled_depth.set(0, queue=self.name)
             return items

@@ -168,6 +168,44 @@ class BeholderService:
                 rel.get("consumer.dedup_window", 4096)
             )
 
+        #: optional caching subsystem (``instance.cache.enabled``; OFF by
+        #: default so the reference's read-every-message semantics and
+        #: the default exposition stay byte-identical): storage reads
+        #: memoized with writer-side invalidation (a progress message's
+        #: ``get_by_id`` stops re-querying Postgres for rows that only
+        #: change on status transitions), and read-only outbound lookups
+        #: TTL-cached OUTSIDE the resilience stack (a hit costs the
+        #: dependency — and the breaker's failure window — nothing).
+        #: Side-effectful GETs (Telegram sendMessage, Emby refresh) are
+        #: never cached (clients.http.read_only_get is an allowlist).
+        self._cache_enabled = bool(config.get("instance.cache.enabled"))
+        if self._cache_enabled:
+            cache_cfg = config.get("instance.cache") or ConfigNode({})
+            if bool(cache_cfg.get("storage.enabled", True)):
+                from beholder_tpu.storage.cached import CachingStorage
+
+                db = CachingStorage(
+                    db,
+                    ttl_s=float(cache_cfg.get("storage.ttl_s", 30.0)),
+                    max_entries=int(
+                        cache_cfg.get("storage.max_entries", 1024)
+                    ),
+                    metrics=self.metrics.registry,
+                )
+                self.db = db
+            if bool(cache_cfg.get("http.enabled", True)):
+                from beholder_tpu.clients.http import (
+                    CachingTransport,
+                    RequestsTransport,
+                )
+
+                transport = CachingTransport(
+                    transport or RequestsTransport(),
+                    ttl_s=float(cache_cfg.get("http.ttl_s", 5.0)),
+                    max_entries=int(cache_cfg.get("http.max_entries", 256)),
+                    metrics=self.metrics.registry,
+                )
+
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),
@@ -467,7 +505,17 @@ def init(
     config = config or Config.load("events")
 
     metrics = Metrics()
-    metrics.expose(metrics_port)
+    #: cache subsystem: optional /metrics response memoization (ETag +
+    #: max-age; scrape storms render the exposition once per window)
+    max_age = (
+        config.get("instance.cache.httpd.metrics_max_age_s")
+        if config.get("instance.cache.enabled")
+        else None
+    )
+    metrics.expose(
+        metrics_port,
+        cache_max_age_s=float(max_age) if max_age else None,
+    )
 
     service = None
     own_db = db is None

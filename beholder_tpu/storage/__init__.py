@@ -11,12 +11,16 @@ index.js:76,140) against an external Postgres. Backends here:
   driver exists in this image, so the transport is built from the spec,
   like the AMQP stack). Tested against :class:`.pg_server.PgTestServer`
   over real sockets.
+- :class:`CachingStorage` — read-through TTL cache over any backend
+  with writer-side invalidation + singleflight (:mod:`.cached`; the
+  cache subsystem's storage wiring, ``instance.cache.storage``).
 
 Rows are surfaced as ``api.Media`` protobuf messages so handler attribute
 access (``media.creator``, ``media.creatorId``, ...) matches the reference.
 """
 
 from .base import MediaNotFound, MemoryStorage, Storage, postgres_storage
+from .cached import CachingStorage
 from .postgres import PostgresStorage
 from .sqlite import SqliteStorage
 
@@ -25,6 +29,7 @@ __all__ = [
     "MemoryStorage",
     "SqliteStorage",
     "PostgresStorage",
+    "CachingStorage",
     "MediaNotFound",
     "postgres_storage",
 ]

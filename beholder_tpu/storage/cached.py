@@ -1,0 +1,87 @@
+"""Memoized storage reads with writer-side invalidation.
+
+EXTENSION BEYOND THE REFERENCE (the reference queries Postgres on every
+message — index.js:76,140). :class:`CachingStorage` wraps any
+:class:`~beholder_tpu.storage.base.Storage` backend and serves
+``get_by_id`` from a TTL'd keyed cache
+(:class:`beholder_tpu.cache.KeyedCache`):
+
+- **Writer-side invalidation.** ``add_media`` / ``update_status`` write
+  through to the backend, then invalidate the row's cache entry — the
+  next read observes the write. The status consumer's own
+  read-after-write (update_status -> get_by_id, index.js:68,76) is
+  therefore never stale, while the progress consumer's pure reads (the
+  hot path: one ``get_by_id`` per progress message, for rows that
+  change only on status transitions) collapse onto the cache.
+- **TTL bound on external writers.** A row changed by a DIFFERENT
+  process (this service is not the only Postgres client in the triton
+  stack) is stale for at most ``ttl_s``.
+- **Singleflight.** Concurrent misses on one id issue ONE backend
+  query; :class:`~beholder_tpu.storage.base.MediaNotFound` propagates
+  to every collapsed caller and is never cached (a row inserted a
+  moment later must be findable).
+
+The service wires this behind ``instance.cache.storage`` (off unless
+``instance.cache.enabled``); constructed directly it works over any
+backend (the Postgres query-cache tests run it against the real wire
+client + PgTestServer).
+"""
+
+from __future__ import annotations
+
+from beholder_tpu import proto
+from beholder_tpu.cache import KeyedCache
+
+from .base import Storage
+
+
+class CachingStorage(Storage):
+    """Read-through cache over a ``Storage`` backend."""
+
+    def __init__(
+        self,
+        inner: Storage,
+        ttl_s: float = 30.0,
+        max_entries: int = 1024,
+        metrics=None,
+        clock=None,
+    ):
+        self.inner = inner
+        kwargs = {"clock": clock} if clock is not None else {}
+        self._cache = KeyedCache(
+            "storage.media",
+            max_entries=max_entries,
+            policy="ttl",
+            ttl_s=ttl_s,
+            metrics=metrics,
+            **kwargs,
+        )
+
+    @property
+    def cache(self) -> KeyedCache:
+        return self._cache
+
+    def add_media(self, media: proto.Media) -> None:
+        self.inner.add_media(media)
+        self._cache.invalidate(media.id)
+
+    def update_status(self, media_id: str, status: int) -> None:
+        self.inner.update_status(media_id, status)
+        self._cache.invalidate(media_id)
+
+    def get_by_id(self, media_id: str) -> proto.Media:
+        # a defensive copy per call: Media is a mutable protobuf and a
+        # caller mutating the returned row must not poison the cache
+        row = self._cache.get_or_load(
+            media_id, lambda: self.inner.get_by_id(media_id)
+        )
+        clone = proto.Media()
+        clone.CopyFrom(row)
+        return clone
+
+    def invalidate(self, media_id: str) -> None:
+        """Explicit invalidation hook for out-of-band writers."""
+        self._cache.invalidate(media_id)
+
+    def close(self) -> None:
+        self.inner.close()

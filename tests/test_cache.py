@@ -1,0 +1,635 @@
+"""Caching subsystem: keyed-cache core (policies, capacity,
+singleflight, invalidation), the Postgres query cache, the outbound
+HTTP lookup cache, the endpoint response cache, the labelled intake
+depth gauge, and the schema-v3 artifact cache block.
+
+All marked ``cache`` (dedicated CI step); the prefix cache's serving
+integration lives in tests/test_prefix_cache.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from beholder_tpu import artifact, proto
+from beholder_tpu.cache import KeyedCache, LFUPolicy, SingleFlight
+from beholder_tpu.clients.http import (
+    CachingTransport,
+    HttpResponse,
+    RecordingTransport,
+    read_only_get,
+)
+from beholder_tpu.httpd import CachedRoute
+from beholder_tpu.metrics import Metrics, Registry
+from beholder_tpu.storage import MemoryStorage
+from beholder_tpu.storage.cached import CachingStorage
+from beholder_tpu.storage.pg_server import PgTestServer
+from beholder_tpu.storage.postgres import PostgresStorage
+
+pytestmark = pytest.mark.cache
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+# -- core: policies + capacity ------------------------------------------------
+
+
+def test_lru_evicts_least_recently_used():
+    c = KeyedCache("t", max_entries=2)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1  # touch a; b is now LRU
+    c.put("c", 3)
+    assert c.get("b") is None and c.get("a") == 1 and c.get("c") == 3
+    assert c.evictions == 1
+
+
+def test_lfu_evicts_least_frequently_used():
+    c = KeyedCache("t", max_entries=2, policy=LFUPolicy())
+    c.put("a", 1)
+    c.put("b", 2)
+    for _ in range(3):
+        c.get("a")
+    c.get("b")
+    c.put("c", 3)  # b has the lowest frequency
+    assert c.get("b") is None and c.get("a") == 1
+
+
+def test_ttl_expires_entries_lazily():
+    clock = FakeClock()
+    c = KeyedCache("t", policy="ttl", ttl_s=10.0, clock=clock)
+    c.put("a", 1)
+    assert c.get("a") == 1
+    clock.advance(10.0)
+    assert c.get("a") is None  # expired exactly at the bound
+    assert c.evictions == 1 and c.hits == 1 and c.misses == 1
+
+
+def test_byte_capacity_accounting():
+    c = KeyedCache("t", max_bytes=100, size_of=len)
+    c.put("a", "x" * 40)
+    c.put("b", "y" * 40)
+    assert c.size_bytes == 80
+    c.put("c", "z" * 40)  # 120 > 100: LRU "a" must go
+    assert c.get("a") is None and len(c) == 2 and c.size_bytes == 80
+    # an entry that can NEVER fit is refused outright, nothing evicted
+    c.put("huge", "h" * 200)
+    assert c.get("huge") is None and len(c) == 2
+
+
+def test_invalidate_and_invalidate_all():
+    c = KeyedCache("t")
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.invalidate("a") is True
+    assert c.invalidate("a") is False  # already gone
+    assert c.get("a") is None and c.get("b") == 2
+    assert c.invalidate_all() == 1
+    assert len(c) == 0 and c.invalidations >= 2
+
+
+# -- core: singleflight -------------------------------------------------------
+
+
+def test_singleflight_collapses_concurrent_misses():
+    c = KeyedCache("t")
+    calls = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def loader():
+        calls.append(1)
+        entered.set()
+        release.wait(timeout=5)
+        return "value"
+
+    results = []
+
+    def leader():
+        results.append(c.get_or_load("k", loader))
+
+    def follower():
+        entered.wait(timeout=5)
+        results.append(
+            c.get_or_load("k", lambda: pytest.fail("follower must collapse"))
+        )
+
+    threads = [threading.Thread(target=leader)] + [
+        threading.Thread(target=follower) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    entered.wait(timeout=5)
+    # hold the leader in the loader until every follower has collapsed
+    # onto its flight (they register BEFORE blocking, and the cache
+    # cannot be populated while the loader is still running)
+    deadline = time.monotonic() + 5
+    while c.collapsed < 4 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    release.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert results == ["value"] * 5
+    assert len(calls) == 1  # ONE underlying call
+    assert c.collapsed == 4
+
+
+def test_singleflight_error_propagates_and_is_not_cached():
+    c = KeyedCache("t")
+    with pytest.raises(RuntimeError):
+        c.get_or_load("k", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert c.get("k") is None
+    assert c.get_or_load("k", lambda: 42) == 42  # next load succeeds
+
+
+def test_invalidate_during_inflight_load_is_not_cached():
+    c = KeyedCache("t")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def loader():
+        entered.set()
+        release.wait(timeout=5)
+        return "stale"
+
+    out = []
+    t = threading.Thread(target=lambda: out.append(c.get_or_load("k", loader)))
+    t.start()
+    entered.wait(timeout=5)
+    c.invalidate("k")  # the writer moved underneath the load
+    release.set()
+    t.join(timeout=5)
+    assert out == ["stale"]  # the loader's value is still returned...
+    assert c.get("k") is None  # ...but never stored
+
+
+def test_standalone_singleflight():
+    sf = SingleFlight()
+    assert sf.do("k", lambda: 7) == 7
+    assert sf.do("k", lambda: 8) == 8  # nothing retained between flights
+
+
+def test_cache_metrics_series():
+    reg = Registry()
+    c = KeyedCache("demo", max_entries=1, metrics=reg)
+    c.put("a", 1)
+    c.get("a")
+    c.get("b")
+    c.put("b", 2)  # evicts a
+    c.invalidate("b")
+    text = reg.render()
+    assert 'beholder_cache_hits_total{cache="demo"} 1' in text
+    assert 'beholder_cache_misses_total{cache="demo"} 1' in text
+    assert (
+        'beholder_cache_evictions_total{cache="demo",reason="capacity"} 1'
+        in text
+    )
+    assert 'beholder_cache_invalidations_total{cache="demo"} 1' in text
+    assert 'beholder_cache_entries{cache="demo"} 0' in text
+
+
+# -- storage: the Postgres query cache ---------------------------------------
+
+
+@pytest.fixture()
+def pg():
+    srv = PgTestServer()
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _media(id="m1", status=0):
+    return proto.Media(
+        id=id, name="Movie", creator=proto.CreatorType.TRELLO,
+        creatorId="card-1", metadataId="42", status=status,
+    )
+
+
+def _selects(pg):
+    return sum(1 for sql, _ in pg.queries if sql.strip().startswith("SELECT"))
+
+
+def test_postgres_query_cache_hits_skip_the_wire(pg):
+    clock = FakeClock()
+    db = CachingStorage(PostgresStorage(pg.url()), ttl_s=30.0, clock=clock)
+    db.add_media(_media())
+    db.get_by_id("m1")
+    before = _selects(pg)
+    for _ in range(5):
+        assert db.get_by_id("m1").name == "Movie"
+    assert _selects(pg) == before  # all five served from the cache
+    db.close()
+
+
+def test_postgres_query_cache_writer_invalidation(pg):
+    clock = FakeClock()
+    db = CachingStorage(PostgresStorage(pg.url()), ttl_s=30.0, clock=clock)
+    db.add_media(_media(status=0))
+    assert db.get_by_id("m1").status == 0
+    db.update_status("m1", 3)  # write-through + invalidate
+    assert db.get_by_id("m1").status == 3  # re-read observes the write
+    db.close()
+
+
+def test_postgres_query_cache_ttl_expiry(pg):
+    clock = FakeClock()
+    db = CachingStorage(PostgresStorage(pg.url()), ttl_s=5.0, clock=clock)
+    db.add_media(_media())
+    db.get_by_id("m1")
+    before = _selects(pg)
+    clock.advance(5.0)
+    db.get_by_id("m1")
+    assert _selects(pg) == before + 1  # expired -> re-queried
+    db.close()
+
+
+def test_caching_storage_returns_defensive_copies():
+    db = CachingStorage(MemoryStorage())
+    db.add_media(_media(status=0))
+    row = db.get_by_id("m1")
+    row.status = 9  # caller mutation must not poison the cache
+    assert db.get_by_id("m1").status == 0
+
+
+def test_caching_storage_not_found_never_cached():
+    from beholder_tpu.storage import MediaNotFound
+
+    db = CachingStorage(MemoryStorage())
+    with pytest.raises(MediaNotFound):
+        db.get_by_id("ghost")
+    db.add_media(_media(id="ghost"))
+    assert db.get_by_id("ghost").id == "ghost"
+
+
+# -- clients: the outbound lookup cache --------------------------------------
+
+
+def test_caching_transport_caches_read_only_lookups():
+    inner = RecordingTransport()
+    inner.responses = [HttpResponse(200, {"name": "board"})]
+    t = CachingTransport(inner, ttl_s=30.0)
+    for _ in range(3):
+        resp = t.request("get", "https://api.trello.com/1/boards/b1")
+        assert resp.body == {"name": "board"}
+    assert len(inner.requests) == 1  # one wire call, two hits
+    assert t.cache.hits == 2
+
+
+def test_caching_transport_allowlist_never_caches_side_effect_gets():
+    # the predicate is an ALLOWLIST: Telegram's sendMessage and Emby's
+    # library/refresh are GETs with side effects
+    assert not read_only_get("get", "https://api.telegram.org/botT/sendMessage")
+    assert not read_only_get("get", "http://emby:8096/emby/library/refresh")
+    assert read_only_get("get", "https://api.trello.com/1/boards/b1")
+    assert not read_only_get("put", "https://api.trello.com/1/cards/c1")
+    inner = RecordingTransport()
+    t = CachingTransport(inner, ttl_s=30.0)
+    for _ in range(3):
+        t.request("get", "https://api.telegram.org/botT/sendMessage",
+                  params={"text": "hi"})
+    assert len(inner.requests) == 3  # every call reaches the wire
+
+
+def test_caching_transport_ttl_and_distinct_params():
+    clock = FakeClock()
+    inner = RecordingTransport()
+    inner.responses = [
+        HttpResponse(200, {"v": 1}),
+        HttpResponse(200, {"v": 2}),
+        HttpResponse(200, {"v": 3}),
+    ]
+    t = CachingTransport(inner, ttl_s=10.0, clock=clock)
+    url = "https://api.trello.com/1/cards/c1"
+    assert t.request("get", url, params={"fields": "name"}).body == {"v": 1}
+    assert t.request("get", url, params={"fields": "desc"}).body == {"v": 2}
+    assert t.request("get", url, params={"fields": "name"}).body == {"v": 1}
+    clock.advance(10.0)
+    assert t.request("get", url, params={"fields": "name"}).body == {"v": 3}
+
+
+def test_caching_transport_returns_defensive_copies():
+    inner = RecordingTransport()
+    inner.responses = [HttpResponse(200, {"lists": ["a", "b"]})]
+    t = CachingTransport(inner, ttl_s=30.0)
+    url = "https://api.trello.com/1/boards/b1"
+    first = t.request("get", url)
+    first.body["lists"].append("MUTATED")  # caller mutation...
+    assert t.request("get", url).body == {"lists": ["a", "b"]}  # ...contained
+
+
+def test_caching_transport_list_valued_params_are_cacheable():
+    # legal for the uncached transport (requests supports list params);
+    # caching must not turn it into a TypeError
+    inner = RecordingTransport()
+    inner.responses = [HttpResponse(200, {"v": 1})]
+    t = CachingTransport(inner, ttl_s=30.0)
+    url = "https://api.trello.com/1/boards/b1"
+    p = {"fields": ["name", "desc"]}
+    assert t.request("get", url, params=p).body == {"v": 1}
+    assert t.request("get", url, params=p).body == {"v": 1}
+    assert len(inner.requests) == 1  # and they share one cache entry
+
+
+def test_caching_transport_error_responses_not_cached():
+    inner = RecordingTransport()
+    inner.responses = [HttpResponse(500, "down"), HttpResponse(200, {"ok": 1})]
+    t = CachingTransport(inner, ttl_s=30.0)
+    url = "https://api.trello.com/1/boards/b1"
+    assert t.request("get", url).status == 500  # passed through, uncached
+    assert t.request("get", url).body == {"ok": 1}
+    assert len(inner.requests) == 2
+
+
+def test_client_lookups_ride_the_cache():
+    from beholder_tpu.clients import EmbyClient, TrelloClient
+
+    inner = RecordingTransport()
+    transport = CachingTransport(inner, ttl_s=30.0)
+    trello = TrelloClient("K", "T", transport=transport)
+    emby = EmbyClient("http://emby:8096", "tok", transport=transport)
+    trello.get_board("b1")
+    trello.get_board("b1")
+    emby.library_folders()
+    emby.library_folders()
+    emby.refresh_library()
+    emby.refresh_library()  # side effect: must hit the wire every time
+    assert len(inner.requests) == 4  # board once, folders once, refresh twice
+
+
+# -- httpd: the endpoint response cache --------------------------------------
+
+
+def test_cached_route_memoizes_and_revalidates():
+    clock = FakeClock()
+    bodies = [b"exposition-1", b"exposition-2"]
+
+    def route():
+        return 200, "text/plain", bodies.pop(0)
+
+    cached = CachedRoute(route, max_age_s=5.0, clock=clock)
+    code, _, body, extra = cached.respond({})
+    assert (code, body) == (200, b"exposition-1")
+    assert extra["Cache-Control"] == "max-age=5" and extra["ETag"]
+    etag = extra["ETag"]
+    # fresh window: memoized body, and If-None-Match gets a 304
+    code, _, body, _ = cached.respond({})
+    assert (code, body) == (200, b"exposition-1")
+    code, _, body, _ = cached.respond({"If-None-Match": etag})
+    assert (code, body) == (304, b"")
+    assert cached.hits == 2 and cached.misses == 1
+    # window over: the route renders again, the ETag moves
+    clock.advance(5.0)
+    code, _, body, extra = cached.respond({"If-None-Match": etag})
+    assert (code, body) == (200, b"exposition-2")
+    assert extra["ETag"] != etag
+
+
+def test_cached_route_never_caches_errors():
+    codes = [(500, b"boom"), (200, b"ok")]
+
+    def route():
+        code, body = codes.pop(0)
+        return code, "text/plain", body
+
+    cached = CachedRoute(route, max_age_s=60.0)
+    assert cached.respond({})[0] == 500
+    assert cached.respond({})[:1] == (200,)  # the error did not stick
+
+
+def test_metrics_endpoint_response_caching_live():
+    m = Metrics()
+    port = m.expose(0, cache_max_age_s=60.0)
+    try:
+        m.progress_updates_total.inc(status="queued")
+        url = f"http://127.0.0.1:{port}/metrics"
+        with urllib.request.urlopen(url) as resp:
+            body1 = resp.read()
+            etag = resp.headers["ETag"]
+            assert resp.headers["Cache-Control"] == "max-age=60"
+        # the counter moves, but the cached window still serves the
+        # memoized exposition...
+        m.progress_updates_total.inc(status="queued")
+        with urllib.request.urlopen(url) as resp:
+            assert resp.read() == body1
+        # ...and revalidation is a body-less 304
+        req = urllib.request.Request(url, headers={"If-None-Match": etag})
+        try:
+            urllib.request.urlopen(req)
+            pytest.fail("expected 304")
+        except urllib.error.HTTPError as err:  # urllib treats 304 as error
+            assert err.code == 304
+    finally:
+        m.close()
+
+
+def test_metrics_endpoint_uncached_by_default():
+    m = Metrics()
+    port = m.expose(0)
+    try:
+        url = f"http://127.0.0.1:{port}/metrics"
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers.get("ETag") is None
+            assert resp.headers.get("Cache-Control") is None
+            resp.read()
+    finally:
+        m.close()
+
+
+# -- service wiring -----------------------------------------------------------
+
+
+def test_service_cache_wiring_enabled():
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import BeholderService
+
+    transport = RecordingTransport()
+    config = ConfigNode({
+        "keys": {"trello": {"key": "K", "token": "T"}},
+        "instance": {
+            "flow_ids": {"queued": "l0"},
+            "cache": {"enabled": True},
+        },
+    })
+    db = MemoryStorage()
+    svc = BeholderService(
+        config, InMemoryBroker(), db, transport=transport
+    )
+    assert isinstance(svc.db, CachingStorage)
+    svc.db.inner.add_media(_media())
+    svc.db.get_by_id("m1")
+    svc.db.get_by_id("m1")
+    text = svc.metrics.registry.render()
+    assert 'beholder_cache_hits_total{cache="storage.media"} 1' in text
+    assert 'beholder_cache_misses_total{cache="storage.media"} 1' in text
+    # the transport stack is cache-wrapped too
+    assert isinstance(svc.trello._transport, CachingTransport)
+
+
+def test_service_semantics_unchanged_with_cache_enabled():
+    """Drive real messages through both consumers with caching ON: the
+    status consumer's read-after-write must observe its own update
+    (writer-side invalidation), and the progress consumer's repeated
+    reads collapse onto the cache without changing side effects."""
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import (
+        PROGRESS_TOPIC,
+        STATUS_TOPIC,
+        BeholderService,
+    )
+
+    S = proto.TelemetryStatusEntry
+    broker = InMemoryBroker(prefetch=100)
+    db = MemoryStorage()
+    transport = RecordingTransport()
+    config = ConfigNode({
+        "keys": {"trello": {"key": "K", "token": "T"}},
+        "instance": {
+            "flow_ids": {"downloading": "list-dl"},
+            "cache": {"enabled": True},
+        },
+    })
+    svc = BeholderService(config, broker, db, transport=transport)
+    db.add_media(_media())
+    svc.start()
+
+    broker.publish(
+        STATUS_TOPIC,
+        proto.encode(
+            proto.TelemetryStatus(mediaId="m1", status=S.DOWNLOADING)
+        ),
+    )
+    # write-through + invalidation: the consumer's own get_by_id saw
+    # the fresh status (it moved the card to the DOWNLOADING list)
+    assert db.get_by_id("m1").status == S.DOWNLOADING
+    (req,) = transport.requests
+    assert req.method == "PUT" and req.params["idList"] == "list-dl"
+
+    for i in range(3):
+        broker.publish(
+            PROGRESS_TOPIC,
+            proto.encode(proto.TelemetryProgress(
+                mediaId="m1", status=S.DOWNLOADING, progress=10 * i,
+            )),
+        )
+    # three comments went out (semantics unchanged)...
+    assert len(transport.requests) == 4
+    # ...but the row was fetched from Postgres-land at most twice: once
+    # by the status consumer, once by the first progress message
+    assert svc.db.cache.hits >= 2
+
+
+def test_service_cache_disabled_is_reference_shaped():
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import BeholderService
+
+    config = ConfigNode({"keys": {"trello": {"key": "K", "token": "T"}}})
+    svc = BeholderService(
+        config, InMemoryBroker(), MemoryStorage(),
+        transport=RecordingTransport(),
+    )
+    assert isinstance(svc.db, MemoryStorage)  # no wrapper
+    assert "beholder_cache" not in svc.metrics.registry.render()
+
+
+# -- reliability: labelled intake depth gauge ---------------------------------
+
+
+def test_intake_queue_labelled_depth_gauge():
+    from beholder_tpu.reliability.shed import IntakeQueue
+
+    reg = Registry()
+    q = IntakeQueue(4, metrics=reg, name="serving.intake")
+    q.offer("a")
+    q.offer("b")
+    text = reg.render()
+    assert 'beholder_intake_queue_depth{queue="serving.intake"} 2' in text
+    assert "beholder_serving_intake_depth 2" in text  # legacy twin intact
+    q.take_all()
+    assert (
+        'beholder_intake_queue_depth{queue="serving.intake"} 0'
+        in reg.render()
+    )
+
+
+def test_unnamed_intake_queues_get_distinct_depth_series():
+    from beholder_tpu.reliability.shed import IntakeQueue
+
+    reg = Registry()
+    q1 = IntakeQueue(4, metrics=reg)
+    q2 = IntakeQueue(4, metrics=reg)
+    assert q1.name != q2.name  # no silent series overwrite
+    q1.offer("a")
+    q2.offer("b")
+    q2.offer("c")
+    text = reg.render()
+    assert f'beholder_intake_queue_depth{{queue="{q1.name}"}} 1' in text
+    assert f'beholder_intake_queue_depth{{queue="{q2.name}"}} 2' in text
+
+
+# -- artifact: schema v3 ------------------------------------------------------
+
+
+def test_artifact_v3_cache_block_roundtrip(tmp_path):
+    from beholder_tpu.cache import PrefixCache
+
+    reg = Registry()
+    pc = PrefixCache(4, metrics=reg)
+    core = KeyedCache("demo", metrics=reg)
+    pc.lookup([b"h1"], 4)  # miss
+    core.get_or_load("k", lambda: 1)
+    rec = artifact.ArtifactRecorder("bench_cache_test")
+    rec.section("s", {"ok": True})
+    rec.record_cache(reg)
+    assert rec.cache["prefix_misses"] == 1.0
+    path = rec.write(str(tmp_path / "a.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema_version"] >= 3
+    assert set(obj["cache"]) == {
+        "prefix_hits", "prefix_misses", "cached_pages", "evictions",
+        "singleflight_collapsed",
+    }
+
+
+def test_artifact_v2_without_cache_block_still_validates():
+    obj = {
+        "schema": artifact.SCHEMA,
+        "schema_version": 2,
+        "name": "old",
+        "created_unix_s": 0.0,
+        "wall_s": 0.0,
+        "outcome": "ok",
+        "error": None,
+        "provenance": {"python": "3", "platform": "x"},
+        "sections": {},
+        "raw_timings": [],
+        "reliability": {"retries": 0, "sheds": 0, "dead_lettered": 0},
+    }
+    artifact.validate(obj)  # no raise
+    obj3 = dict(obj, schema_version=3)
+    with pytest.raises(ValueError, match="cache"):
+        artifact.validate(obj3)
+
+
+def test_committed_artifact_is_v3_with_cache_section():
+    with open(artifact.DEFAULT_DIR + "/bench_e2e.json") as f:
+        obj = json.load(f)
+    artifact.validate(obj)
+    assert obj["schema_version"] >= 3
+    assert "prefix_cache" in obj["sections"]

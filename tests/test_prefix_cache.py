@@ -1,0 +1,326 @@
+"""Automatic prefix cache: radix index semantics, warm-vs-cold
+equivalence through the per-event scheduler, pool-pressure eviction,
+and the acceptance stress test — a cold cached page shared with a live
+or forked slot must NEVER be reclaimed (the refcount invariant).
+
+Marked ``cache`` (dedicated CI step). Models are deliberately tiny:
+the claims here are about scheduling, hashing, and refcounts, not
+kernel speed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.cache import PrefixCache, page_hashes
+from beholder_tpu.metrics import Registry
+from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+from beholder_tpu.models import serving as sv
+from beholder_tpu.models.serving import ContinuousBatcher, Request
+from beholder_tpu.proto import TelemetryStatusEntry
+
+pytestmark = pytest.mark.cache
+
+PAGE = 4
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 32, model=model)
+    return model, state.params
+
+
+def _shared_prefix(n_deltas, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.cumsum(1.0 + rng.normal(0, 0.05, n_deltas + 1))
+
+
+def _request(prefix, tail_seed, tail_deltas=6, horizon=3):
+    rng = np.random.default_rng(10_000 + tail_seed)
+    tail = prefix[-1] + np.cumsum(1.0 + rng.normal(0, 0.05, tail_deltas))
+    prog = np.concatenate([prefix, tail])
+    stats = np.full(len(prog), int(TelemetryStatusEntry.CONVERTING))
+    return Request(prog, stats, horizon)
+
+
+def _batcher(model, params, cache=None, num_pages=64, slots=4, **kw):
+    return ContinuousBatcher(
+        model, params, num_pages=num_pages, page_size=PAGE, slots=slots,
+        max_prefix=32, max_pages_per_seq=16, prefix_cache=cache, **kw,
+    )
+
+
+# -- radix index (host-side, no device) ---------------------------------------
+
+
+def test_page_hashes_chain_and_align():
+    feats = np.random.default_rng(0).normal(size=(11, 3)).astype(np.float32)
+    hs = page_hashes(feats, 4)
+    assert len(hs) == 2  # only FULL pages are hashed
+    # chained: a different FIRST page changes every downstream key
+    other = feats.copy()
+    other[0, 0] += 1.0
+    hs2 = page_hashes(other, 4)
+    assert hs[0] != hs2[0] and hs[1] != hs2[1]
+    # a shared first page with divergent second keeps the common key
+    other = feats.copy()
+    other[5, 0] += 1.0
+    hs3 = page_hashes(other, 4)
+    assert hs3[0] == hs[0] and hs3[1] != hs[1]
+
+
+def test_lookup_longest_chain_and_cap():
+    pc = PrefixCache(4)
+    hs = [b"a", b"b", b"c"]
+    pc.insert(hs, [10, 11, 12])
+    assert pc.lookup(hs, max_pages=3) == [10, 11, 12]
+    assert pc.lookup(hs, max_pages=2) == [10, 11]  # the always-prefill cap
+    assert pc.lookup([b"a", b"x", b"c"], max_pages=3) == [10]  # chain breaks
+    assert pc.hits == 3 and pc.misses == 0
+    assert pc.lookup([b"z"], max_pages=1) == []
+    assert pc.misses == 1
+
+
+def test_eviction_is_lru_leaf_first():
+    pc = PrefixCache(4)
+    pc.insert([b"a", b"b"], [1, 2])  # chain a -> b
+    pc.insert([b"c"], [3])
+    pc.lookup([b"a", b"b"], 2)  # touch the a-chain: c is now LRU
+    assert pc.evict(1) == [3]
+    # interior "a" is protected while leaf "b" exists
+    assert pc.evict(2) == [2, 1]  # leaf first, then the freed parent
+    assert pc.page_count == 0 and pc.evictions == 3
+
+
+def test_eviction_never_takes_live_chains():
+    pc = PrefixCache(4)
+    pc.insert([b"a", b"b"], [1, 2])
+    pc.acquire([b"a", b"b"])
+    assert pc.evict(5) == []  # pinned by a live slot
+    pc.release([b"a", b"b"])
+    assert sorted(pc.evict(5)) == [1, 2]
+
+
+def test_insert_skips_already_cached_keys():
+    pc = PrefixCache(4)
+    new, _ = pc.insert([b"a", b"b"], [1, 2])
+    assert new == [1, 2]
+    # a duplicate prefill of the same content in other pages: nothing
+    # new indexed, the duplicates stay owned by their slot alone
+    new, _ = pc.insert([b"a", b"b"], [7, 8])
+    assert new == []
+    assert pc.lookup([b"a", b"b"], 2) == [1, 2]
+
+
+# -- scheduler integration ----------------------------------------------------
+
+
+def test_warm_pass_matches_cold_and_uncached(model_and_params):
+    model, params = model_and_params
+    prefix = _shared_prefix(16)  # 4 full shared pages
+    requests = [_request(prefix, s) for s in range(4)]
+
+    reference = _batcher(model, params).run(requests)
+
+    pc = PrefixCache(PAGE)
+    b = _batcher(model, params, cache=pc)
+    cold = b.run(requests)
+    cold_tokens = pc.prefill_tokens
+    assert pc.misses == 4 and pc.hits == 0
+    warm = b.run(requests)
+    warm_tokens = pc.prefill_tokens - cold_tokens
+    assert pc.hits == 4
+    # the warm pass prefilled ONLY the uncached suffixes
+    assert warm_tokens < cold_tokens / 2
+    for i in range(4):
+        np.testing.assert_allclose(
+            cold[i], reference[i], rtol=3e-2, atol=1.5e-2,
+            err_msg=f"cold {i}",
+        )
+        np.testing.assert_allclose(
+            warm[i], reference[i], rtol=3e-2, atol=1.5e-2,
+            err_msg=f"warm {i}",
+        )
+
+
+def test_warm_pass_matches_cold_under_int8_pools(model_and_params):
+    """The warm path must survive quantized pools: adopted pages are
+    dequantized into the dense suffix context, and the fresh suffix KV
+    re-quantizes on the way into its pages. Tolerance is the int8
+    serving tests' (cold prefill attends unquantized KV; a warm suffix
+    attends the dequantized pages — one quantization step apart)."""
+    model, params = model_and_params
+    prefix = _shared_prefix(16)
+    requests = [_request(prefix, s) for s in range(3)]
+    pc = PrefixCache(PAGE)
+    b = _batcher(model, params, cache=pc, cache_dtype="int8")
+    cold = b.run(requests)
+    warm = b.run(requests)
+    assert pc.hits == 3
+    for i in range(3):
+        np.testing.assert_allclose(
+            warm[i], cold[i], rtol=5e-2, atol=5e-2, err_msg=f"request {i}"
+        )
+
+
+def test_prefix_metrics_on_registry(model_and_params):
+    model, params = model_and_params
+    reg = Registry()
+    pc = PrefixCache(PAGE, metrics=reg)
+    b = _batcher(model, params, cache=pc)
+    requests = [_request(_shared_prefix(12), s) for s in range(2)]
+    b.run(requests)
+    b.run(requests)
+    text = reg.render()
+    assert "beholder_prefix_cache_hits_total 2" in text
+    assert "beholder_prefix_cache_misses_total 2" in text
+    assert f"beholder_prefix_cache_cached_pages {pc.page_count}" in text
+    assert "beholder_prefix_cache_prefill_tokens_total" in text
+
+
+def test_pool_pressure_evicts_cold_pages_and_serves(model_and_params):
+    model, params = model_and_params
+    pc = PrefixCache(PAGE)
+    # pool of 8: request A (12 deltas + horizon 3 -> ceil(14/4) = 4
+    # pages, 3 of them cached on retire) leaves free = 8 - 3 = 5; B
+    # needs 6 pages -> must evict A's cold chain to admit
+    b = _batcher(model, params, cache=pc, num_pages=8, slots=1)
+    a = _request(_shared_prefix(12, seed=1), 0, tail_deltas=0, horizon=3)
+    b.run([a])
+    assert pc.page_count == 3 and pc.cold_page_count == 3
+    big = _request(_shared_prefix(18, seed=2), 1, tail_deltas=0, horizon=6)
+    reference = _batcher(model, params, num_pages=8, slots=1).run([big])
+    got = b.run([big])
+    assert pc.evictions >= 1  # pressure reclaimed cold pages
+    np.testing.assert_allclose(got[0], reference[0], rtol=3e-2, atol=1.5e-2)
+
+
+def test_pressure_never_evicts_the_claiming_requests_own_hit_chain(
+    model_and_params,
+):
+    """The admit looks up and PINS its hit chain before pool-pressure
+    eviction runs, so under pressure the eviction reclaims OTHER cold
+    chains — a warm request must keep its hit instead of evicting the
+    very pages it is about to adopt."""
+    model, params = model_and_params
+    pc = PrefixCache(PAGE)
+    b = _batcher(model, params, cache=pc, num_pages=8, slots=1)
+    a = _request(_shared_prefix(12, seed=1), 0, tail_deltas=0, horizon=3)
+    other = _request(_shared_prefix(12, seed=2), 1, tail_deltas=0, horizon=3)
+    b.run([a])       # 3 cold pages (a's chain, the LRU victim candidate)
+    b.run([other])   # 3 more cold pages
+    assert pc.cold_page_count == 6
+    hits_before, evictions_before = pc.hits, pc.evictions
+    b.run([a])  # replay a under pressure: free = 8 - 6 < need = 4
+    assert pc.hits == hits_before + 1  # the hit survived...
+    # ...because eviction (if any was needed) took the OTHER chain, not
+    # the pinned one: a's capped 2-page hit chain is still indexed
+    assert pc.lookup(pc.hashes(b._prep_np(a)[0]), 2) != []
+    assert not bool(jax.device_get(b.state.alloc_failed))
+    assert pc.evictions == evictions_before  # pinning made room w/o evicting
+
+
+def test_repeated_mixed_rounds_keep_allocator_consistent(model_and_params):
+    """Churn: shared-prefix waves with retirements, cache reuse, and
+    pressure evictions across rounds — the sticky alloc_failed flag
+    (checked by every run()) must never trip."""
+    model, params = model_and_params
+    pc = PrefixCache(PAGE)
+    b = _batcher(model, params, cache=pc, num_pages=7, slots=2)
+    prefixes = [_shared_prefix(8, seed=s) for s in range(3)]
+    for round_i in range(4):
+        requests = [
+            _request(prefixes[(round_i + j) % 3], j, tail_deltas=2, horizon=2)
+            for j in range(3)
+        ]
+        b.run(requests)
+    assert not bool(jax.device_get(b.state.alloc_failed))
+    assert pc.hits > 0 and pc.evictions > 0
+
+
+def test_run_pending_defaults_to_per_event_scheduler(model_and_params):
+    model, params = model_and_params
+    pc = PrefixCache(PAGE)
+    b = _batcher(model, params, cache=pc, max_pending=8)
+    req = _request(_shared_prefix(8), 0, tail_deltas=2, horizon=2)
+    assert b.submit(req).accepted
+    b.run_pending()  # defaults to run() in cache mode -> populates
+    assert pc.page_count > 0
+
+
+# -- the acceptance stress test: refcount invariant under fork ----------------
+
+
+def test_eviction_never_reclaims_pages_shared_with_live_or_forked_slots(
+    model_and_params,
+):
+    """Fill the pool, cache a chain, share it with a LIVE fork, then
+    force eviction of every cold page: the shared pages must survive
+    (device refcount > 1), their content must be byte-identical for the
+    forked reader, and they must return to the free stack only when the
+    last owner retires."""
+    model, params = model_and_params
+    num_pages = 8
+    state = sv.init_paged(model, num_pages, PAGE, slots=3, max_pages_per_seq=4)
+    from beholder_tpu.models.sequence import FEATURES
+
+    t = 8  # 2 full pages, no tail
+    feats = (
+        np.random.default_rng(0)
+        .normal(size=(1, t, FEATURES))
+        .astype(np.float32)
+    )
+    _, state = sv.paged_admit_batch(
+        model, params, state,
+        jnp.zeros((1,), jnp.int32), jnp.asarray(feats),
+        jnp.full((1,), t, jnp.int32),
+    )
+    row = np.asarray(state.page_table[0])[: t // PAGE]
+    pages = [int(p) for p in row]
+
+    # index + the cache's reference (what _index_admitted does)
+    pc = PrefixCache(PAGE)
+    hashes = page_hashes(feats[0], PAGE)
+    new_ids, _ = pc.insert(hashes, pages)
+    assert new_ids == pages
+    ids = jnp.asarray(pages, jnp.int32)
+    state = sv.cache_ref_pages(state, ids, jnp.ones(len(pages), bool))
+    assert [int(r) for r in np.asarray(state.page_ref)[pages]] == [2, 2]
+
+    # fork slot 0 -> slot 1 (full pages shared by reference), then
+    # retire slot 0: pages now = cache ref + forked slot ref
+    state = sv.paged_fork(state, jnp.int32(0), jnp.asarray([1], jnp.int32))
+    state = sv.paged_release(state, jnp.int32(0))
+    assert [int(r) for r in np.asarray(state.page_ref)[pages]] == [2, 2]
+    before_k, before_v = sv.slot_cache(state, 1, 0)
+
+    # pool pressure: evict EVERY cold page (the chain has no live cache
+    # users -- the fork is invisible to the host index, which is exactly
+    # the hazard this test pins)
+    evicted = pc.evict(len(pages))
+    assert sorted(evicted) == sorted(pages)
+    alive = np.zeros(num_pages, bool)
+    padded = np.zeros(num_pages, np.int32)
+    padded[: len(evicted)] = evicted
+    alive[: len(evicted)] = True
+    free_before = int(state.free_top)
+    state = sv.cache_unref_pages(
+        state, jnp.asarray(padded), jnp.asarray(alive)
+    )
+    # the refcount invariant: still held by the live fork, NOT freed
+    assert [int(r) for r in np.asarray(state.page_ref)[pages]] == [1, 1]
+    assert int(state.free_top) == free_before
+    free_stack = np.asarray(state.free_stack)[: int(state.free_top)]
+    assert not set(pages) & set(int(p) for p in free_stack)
+    # the forked reader still sees byte-identical content
+    after_k, after_v = sv.slot_cache(state, 1, 0)
+    np.testing.assert_array_equal(np.asarray(before_k), np.asarray(after_k))
+    np.testing.assert_array_equal(np.asarray(before_v), np.asarray(after_v))
+
+    # last owner retires -> NOW the pages free; the pool drains back
+    state = sv.paged_release(state, jnp.int32(1))
+    assert [int(r) for r in np.asarray(state.page_ref)[pages]] == [0, 0]
+    assert int(state.free_top) == num_pages
+    assert not bool(jax.device_get(state.alloc_failed))
