@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache lint clean
+.PHONY: all proto native test bench bench-cache bench-spec lint clean
 
 all: proto native
 
@@ -44,6 +44,14 @@ bench:
 # same scenario inside bench_e2e.json)
 bench-cache:
 	python bench.py --cache-only
+
+# the speculative-decoding scenario alone: replay a decode-heavy mix
+# with spec off then on, report mean accepted draft length (> 1 means
+# fewer verify steps than tokens; writes artifacts/bench_spec.json —
+# the full `make bench` run carries the same scenario inside
+# bench_e2e.json)
+bench-spec:
+	python bench.py --spec-only
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

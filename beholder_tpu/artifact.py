@@ -36,7 +36,10 @@ Schema (``validate`` is the authoritative checker)::
                       "dead_lettered": 0.0},  # v2: reliability counters
       "cache": {"prefix_hits": 0.0, "prefix_misses": 0.0,
                 "cached_pages": 0.0, "evictions": 0.0,
-                "singleflight_collapsed": 0.0}  # v3: cache counters
+                "singleflight_collapsed": 0.0},  # v3: cache counters
+      "spec": {"drafted": 0.0, "accepted": 0.0, "rejected": 0.0,
+               "rollbacks": 0.0,
+               "mean_accept_len": 0.0}  # v4: speculative decoding
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -53,6 +56,14 @@ singleflight collapses across every keyed cache. A headline figure that
 leaned on warm caches now says so; the bench-cache scenario's warm/cold
 prefill ratio is backed by these counters. v1/v2 artifacts remain
 valid.
+
+Schema v4 (the speculative-decoding PR): the run's spec counters ride
+along (:meth:`ArtifactRecorder.record_spec`) — draft tokens submitted /
+accepted / rejected, rejected-suffix rollbacks, and ``mean_accept_len``
+(emitted tokens per verify slot-step; > 1 means the run decoded more
+tokens than it dispatched verify steps, the figure speculative decoding
+exists to move — the ``make bench-spec`` acceptance gate). v1-v3
+artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -64,7 +75,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 #: artifact key -> the counter family summed into it (across labels)
 RELIABILITY_COUNTERS = {
@@ -91,6 +102,19 @@ CACHE_COUNTERS = {
 #: v3: the snapshot gauge — pages resident in the prefix cache when the
 #: registry was recorded (latest snapshot wins, not a sum)
 CACHE_PAGES_GAUGE = "beholder_prefix_cache_cached_pages"
+
+#: v4: artifact key -> the speculative-decoding counter summed into it
+SPEC_COUNTERS = {
+    "drafted": "beholder_spec_drafted_tokens_total",
+    "accepted": "beholder_spec_accepted_tokens_total",
+    "rejected": "beholder_spec_rejected_tokens_total",
+    "rollbacks": "beholder_spec_rollbacks_total",
+}
+
+#: v4: the two series ``mean_accept_len`` derives from (emitted tokens
+#: per verify slot-step)
+SPEC_EMITTED_COUNTER = "beholder_spec_emitted_tokens_total"
+SPEC_STEPS_COUNTER = "beholder_spec_verify_steps_total"
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
@@ -160,6 +184,9 @@ class ArtifactRecorder:
             key: 0.0 for key in CACHE_COUNTERS
         }
         self.cache["cached_pages"] = 0.0
+        self.spec: dict[str, float] = {key: 0.0 for key in SPEC_COUNTERS}
+        self._spec_emitted = 0.0
+        self._spec_steps = 0.0
 
     def section(
         self,
@@ -233,6 +260,30 @@ class ArtifactRecorder:
         if gauge is not None:
             self.cache["cached_pages"] = float(gauge.value())
 
+    def record_spec(self, registry) -> None:
+        """Accumulate one registry's speculative-decoding counters
+        (drafted/accepted/rejected tokens, rollbacks; emitted tokens
+        and verify slot-steps feed the derived ``mean_accept_len``).
+        Same accumulate-across-registries contract as
+        :meth:`record_reliability`."""
+        find = getattr(registry, "find", None)
+        if find is None:  # a Metrics wrapper
+            registry = getattr(registry, "registry", None)
+            find = getattr(registry, "find", None)
+            if find is None:
+                return
+        for key, name in SPEC_COUNTERS.items():
+            counter = find(name)
+            if counter is not None:
+                self.spec[key] += float(counter.total())
+        for attr, name in (
+            ("_spec_emitted", SPEC_EMITTED_COUNTER),
+            ("_spec_steps", SPEC_STEPS_COUNTER),
+        ):
+            counter = find(name)
+            if counter is not None:
+                setattr(self, attr, getattr(self, attr) + float(counter.total()))
+
     def to_dict(self) -> dict[str, Any]:
         outcome = "ok"
         if self.error is not None:
@@ -253,6 +304,14 @@ class ArtifactRecorder:
             "raw_timings": self.raw,
             "reliability": dict(self.reliability),
             "cache": dict(self.cache),
+            "spec": {
+                **self.spec,
+                "mean_accept_len": (
+                    round(self._spec_emitted / self._spec_steps, 4)
+                    if self._spec_steps
+                    else 0.0
+                ),
+            },
         }
 
     def write(self, path: str | None = None) -> str:
@@ -303,6 +362,14 @@ def record_cache(registry) -> None:
     no-op without one (same contract as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_cache(registry)
+
+
+def record_spec(registry) -> None:
+    """Accumulate a registry's speculative-decoding counters into the
+    active recorder; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_spec(registry)
 
 
 # -- validation ---------------------------------------------------------------
@@ -365,6 +432,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"cache.{key} must be a number, "
                         f"got {cache.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 4:
+        # v4: speculative-decoding counters are part of the evidence
+        spec = obj.get("spec")
+        if not isinstance(spec, dict):
+            problems.append("spec must be a dict (schema v4+)")
+        else:
+            for key in (*SPEC_COUNTERS, "mean_accept_len"):
+                if not isinstance(spec.get(key), (int, float)):
+                    problems.append(
+                        f"spec.{key} must be a number, "
+                        f"got {spec.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

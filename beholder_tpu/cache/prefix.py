@@ -119,6 +119,16 @@ class PrefixCache:
         return len(self._entries)
 
     @property
+    def page_ids(self) -> set[int]:
+        """Pool page ids currently indexed. Introspection for tests and
+        safety assertions — the speculative-decoding rollback stress
+        test uses it to pin that a rejected-suffix rollback never frees
+        a page the cache still indexes (rollback only ever truncates
+        DECODE-time pages, which are never inserted into the index; the
+        device refcount enforces the same invariant independently)."""
+        return {e.page_id for e in self._entries.values()}
+
+    @property
     def cold_page_count(self) -> int:
         """Cached pages with no live slot user — the pool headroom the
         cache could surrender under pressure (an upper bound: a cold

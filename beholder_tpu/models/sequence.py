@@ -180,15 +180,29 @@ class Block(nn.Module):
             else:
                 if getattr(index, "ndim", 0) == 1:
                     # per-sequence positions (continuous batching: each
-                    # slot sits at its own length) — scatter one column
-                    # per batch row; t must be 1 on this path
+                    # slot sits at its own length). t == 1 is the classic
+                    # decode tick; t > 1 is a PER-ROW chunked
+                    # continuation (speculative verify: every slot scores
+                    # a draft chunk at its own offset) — row b's columns
+                    # land at index[b]..index[b]+t-1
                     rows = jnp.arange(b)
-                    k_cache = k_cache.at[rows, :, index, :].set(
-                        k[:, :, 0, :].astype(k_cache.dtype)
-                    )
-                    v_cache = v_cache.at[rows, :, index, :].set(
-                        v[:, :, 0, :].astype(v_cache.dtype)
-                    )
+                    if t == 1:
+                        k_cache = k_cache.at[rows, :, index, :].set(
+                            k[:, :, 0, :].astype(k_cache.dtype)
+                        )
+                        v_cache = v_cache.at[rows, :, index, :].set(
+                            v[:, :, 0, :].astype(v_cache.dtype)
+                        )
+                    else:
+                        pos_w = index[:, None] + jnp.arange(t)  # (B, t)
+                        k_cache = k_cache.at[rows[:, None], :, pos_w, :].set(
+                            k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
+                            mode="drop",
+                        )
+                        v_cache = v_cache.at[rows[:, None], :, pos_w, :].set(
+                            v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
+                            mode="drop",
+                        )
                 else:
                     k_cache = jax.lax.dynamic_update_slice(
                         k_cache, k.astype(k_cache.dtype), (0, 0, index, 0)
@@ -210,13 +224,27 @@ class Block(nn.Module):
                     "bhgqd,bhkd->bhgqk", qg, k_cache
                 ) / jnp.sqrt(jnp.float32(dh))
                 positions = jnp.arange(k_cache.shape[2])
-                if getattr(index, "ndim", 0) == 1:
+                if getattr(index, "ndim", 0) == 1 and t == 1:
                     live = positions[None, :] <= index[:, None]  # (B, L)
                     if self.window is not None:
                         live = live & (
                             positions[None, :] > index[:, None] - self.window
                         )
                     live = live[:, None, None, None, :]
+                elif getattr(index, "ndim", 0) == 1:
+                    # per-row chunked continuation: query j of row b is
+                    # position index[b] + j and sees cache positions
+                    # <= itself — the t>1 causal-offset mask, per row
+                    pos_q = index[:, None] + jnp.arange(t)       # (B, t)
+                    live = (
+                        positions[None, None, :] <= pos_q[:, :, None]
+                    )                                            # (B, t, L)
+                    if self.window is not None:
+                        live = live & (
+                            positions[None, None, :]
+                            > pos_q[:, :, None] - self.window
+                        )
+                    live = live[:, None, None, :, :]
                 else:
                     # scalar index: positions index..index+t-1 are being
                     # decoded this call. t == 1 is the classic decode

@@ -1049,6 +1049,15 @@ class ContinuousBatcher:
     program releases everything in-program, so it trades cache reuse
     for fusion. Off (None, the default) every path is byte-identical
     to the uncached batcher.
+
+    ``spec`` (a :class:`beholder_tpu.spec.SpecConfig`) arms
+    :meth:`run_spec`: draft-then-verify decoding where one chunked
+    model step scores k draft tokens per slot through the dense-cache
+    forward, accepted KV lands in the paged pool and rejected suffixes
+    roll back refcount-aware — N tokens per scheduled step instead of
+    one. Composes with ``prefix_cache`` (warm admits adopt cached
+    pages; rollback never reclaims a shared page). Off (None, the
+    default) nothing changes.
     """
 
     def __init__(
@@ -1068,6 +1077,7 @@ class ContinuousBatcher:
         max_pending: int | None = None,
         max_pending_pages: int | None = None,
         prefix_cache=None,
+        spec=None,
     ):
         self.model = model
         self.params = params
@@ -1080,10 +1090,13 @@ class ContinuousBatcher:
             cache_dtype=cache_dtype,
         )
         self.slots = slots
+        self._registry = (
+            getattr(metrics, "registry", metrics)
+            if metrics is not None
+            else None
+        )
         self._metrics = (
-            _ServingMetrics(
-                getattr(metrics, "registry", metrics), num_pages
-            )
+            _ServingMetrics(self._registry, num_pages)
             if metrics is not None
             else None
         )
@@ -1116,6 +1129,24 @@ class ContinuousBatcher:
                 f"batcher page_size {page_size}"
             )
         self.prefix_cache = prefix_cache
+        #: optional speculative decoding (spec subsystem): a
+        #: :class:`beholder_tpu.spec.SpecConfig` turns :meth:`run_spec`
+        #: on — draft-then-verify decoding over this batcher's paged
+        #: pool. None (the default) leaves every path byte-identical.
+        if spec is not None:
+            from beholder_tpu.spec import SpecConfig
+
+            if not isinstance(spec, SpecConfig):
+                raise TypeError(
+                    f"spec must be a beholder_tpu.spec.SpecConfig, got "
+                    f"{type(spec).__name__}"
+                )
+        self.spec = spec
+        #: lazily built by the spec scheduler (a drafter may hold its
+        #: own paged state across calls; the controller's EMA carries)
+        self._spec_drafter = None
+        self._spec_controller = None
+        self._spec_metrics = None
         #: hash chain (full prefix pages) each live slot holds in the
         #: prefix cache; released at retirement
         self._slot_chain: list[list[bytes]] = [[] for _ in range(slots)]
@@ -1142,9 +1173,14 @@ class ContinuousBatcher:
     def _need_pages(self, req: Request) -> int:
         """Worst-case pages a request consumes: prefix + the horizon-1
         fed-back tokens (the horizon-th prediction needs no tick — see
-        run()'s early release)."""
+        run()'s early release). With spec configured, a verify step may
+        tentatively write up to ``max_draft`` tokens past the final
+        accepted end before rollback reclaims them, so admission (and
+        the intake's shed cost) must budget that transient too."""
         feats_len = len(req.progress) - 1
         tokens = feats_len + max(req.horizon - 1, 0)
+        if self.spec is not None:
+            tokens += self.spec.max_draft
         return -(-tokens // self.page_size)
 
     def _prep_np(self, req: Request):
@@ -1225,6 +1261,78 @@ class ContinuousBatcher:
         "page pool exhausted mid-run (device allocator tripped despite "
         "host headroom checks) — raise num_pages"
     )
+
+    def _claim_admissions(
+        self, queue, results, req_of, free_pages, commit
+    ) -> list[tuple[int, int, np.ndarray, int, list, list]]:
+        """One admission round's CLAIM loop, shared by the per-event
+        scheduler (:meth:`run`) and the speculative scheduler
+        (``spec.scheduler``): claim every (slot, request) pair that
+        fits under the page-headroom arithmetic, so both paths carry
+        the same hardening invariants — prefix-cache hit chains are
+        looked up and PINNED before any pressure eviction this round
+        (eviction must never reclaim pages a claim is about to adopt;
+        pinned pages leave the cold set so ``free_pages`` stops
+        reserving them — the claim's full ``need`` covers them
+        instead), pins are released on deferral, stats count once per
+        ADMISSION (``record=False`` probes — a deferred request
+        re-probes every round), and zero-horizon requests resolve
+        without a prefill round-trip.
+
+        ``free_pages`` is the caller's headroom closure (it must see
+        ``commit``'s bookkeeping within the same round);
+        ``commit(slot, rid, req, need)`` records the caller's per-slot
+        state for each claim. Returns the claimed batch as
+        (slot, rid, feats, t, hit_pages, hashes) tuples; raises when
+        nothing is active and the head request can never fit."""
+        batch: list[tuple[int, int, np.ndarray, int, list, list]] = []
+        for slot in range(self.slots):
+            if not queue or req_of[slot] is not None:
+                continue
+            rid, req = queue[0]
+            if req.horizon <= 0:
+                # forecast_deltas(horizon=0) returns an empty array;
+                # skip the prefill/alloc round-trip entirely
+                queue.pop(0)
+                results[rid] = np.zeros(0, np.float32)
+                continue
+            self._check_servable(req)
+            feats_np, t = self._prep_np(req)
+            hit_pages: list[int] = []
+            hashes: list[bytes] = []
+            pinned: list[bytes] = []
+            if self.prefix_cache is not None:
+                hashes = self.prefix_cache.hashes(feats_np)
+                hit_pages = self.prefix_cache.lookup(
+                    hashes, (t - 1) // self.page_size, record=False
+                )
+                pinned = hashes[: len(hit_pages)]
+                self.prefix_cache.acquire(pinned)
+            need = self._need_pages(req)
+            free = free_pages()
+            if need > free and self.prefix_cache is not None:
+                # pool pressure: surrender cold cached pages before
+                # deferring (the cache is a best-effort tenant; pinned
+                # chains are protected by live_users)
+                free += self._evict_cached(need - free)
+            if need > free:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.release(pinned)  # not admitted
+                if not any(r is not None for r in req_of):
+                    raise RuntimeError(
+                        "page pool exhausted: request needs "
+                        f"{need} pages but only {free} exist free — "
+                        "raise num_pages or lower concurrency"
+                    )
+                break  # defer until an active request retires
+            queue.pop(0)
+            if self.prefix_cache is not None:
+                self._slot_chain[slot] = pinned
+                self.prefix_cache.record_admit(hit_pages)
+            batch.append((slot, rid, feats_np, t, hit_pages, hashes))
+            req_of[slot] = rid
+            commit(slot, rid, req, need)
+        return batch
 
     def _check_servable(self, req: Request):
         need = self._need_pages(req)
@@ -1312,15 +1420,46 @@ class ContinuousBatcher:
         cache wired, the default flips to the per-event scheduler —
         ``run_waves``' fused admit+scan+release program releases every
         page in-program, so only ``run`` can reuse and repopulate the
-        cache; pass ``waves`` explicitly to override either way."""
+        cache; with a ``spec`` config it flips further to the
+        speculative scheduler (:meth:`run_spec`, which composes with
+        the prefix cache). Pass ``waves`` explicitly to override either
+        way (``waves=False`` still picks spec when configured)."""
         if self.intake is None:
             raise RuntimeError("no intake queue configured")
-        if waves is None:
-            waves = self.prefix_cache is None
         pending = self.intake.take_all()
         if not pending:
             return []
-        return self.run_waves(pending) if waves else self.run(pending)
+        if waves is None:
+            waves = self.prefix_cache is None and self.spec is None
+        if waves:
+            return self.run_waves(pending)
+        if self.spec is not None:
+            return self.run_spec(pending)
+        return self.run(pending)
+
+    # -- speculative path: draft-then-verify ----------------------------
+
+    def run_spec(self, requests: list[Request]) -> list[np.ndarray]:
+        """Speculative decoding over the paged pool: a drafter proposes
+        up to k tokens per slot, ONE chunked verify step scores them
+        all through the dense-cache forward, accepted tokens' KV stays
+        chunk-scattered in the pool and the rejected suffix's pages
+        roll back. Requires the batcher to be built with ``spec=``
+        (:class:`beholder_tpu.spec.SpecConfig`); see
+        :mod:`beholder_tpu.spec` for the exactness and distribution
+        guarantees. Results match :meth:`run`'s contract; under greedy
+        exact acceptance the stream is bitwise-independent of the
+        drafter and tracks the dense reference rollout to
+        reassociation ULPs.
+        """
+        if self.spec is None:
+            raise RuntimeError(
+                "no spec config — construct the batcher with "
+                "spec=SpecConfig(...) to use run_spec()"
+            )
+        from beholder_tpu.spec.scheduler import run_spec
+
+        return run_spec(self, requests)
 
     # -- flexible path: per-tick scheduling -----------------------------
 
@@ -1437,68 +1576,19 @@ class ContinuousBatcher:
 
         while queue or any(r is not None for r in req_of):
             # admission round: claim every (slot, request) pair that fits
-            # under the page-headroom arithmetic, then admit them all in
+            # under the page-headroom arithmetic (the claim loop — pin-
+            # before-evict, deferral, once-per-admission stats — is
+            # shared with the spec scheduler), then admit them all in
             # ONE batched-prefill dispatch (host traffic per scheduling
             # EVENT, not per request)
-            batch: list[tuple[int, int, np.ndarray, int, list, list]] = []
-            for slot in range(self.slots):
-                if not queue or req_of[slot] is not None:
-                    continue
-                rid, req = queue[0]
-                if req.horizon <= 0:
-                    # forecast_deltas(horizon=0) returns an empty array;
-                    # skip the prefill/alloc round-trip entirely
-                    queue.pop(0)
-                    results[rid] = np.zeros(0, np.float32)
-                    continue
-                self._check_servable(req)
-                feats_np, t = self._prep_np(req)
-                hit_pages: list[int] = []
-                hashes: list[bytes] = []
-                pinned: list[bytes] = []
-                if self.prefix_cache is not None:
-                    # look up and PIN the hit chain BEFORE any pressure
-                    # eviction below (this claim's or a later one's this
-                    # round): eviction must never reclaim pages this
-                    # request is about to adopt. Pinned pages leave the
-                    # cold set, so free_pages() stops reserving them —
-                    # they are covered by this request's full `need`
-                    # instead (the slot's own pops stay bounded by
-                    # need - hits, so the admission invariant holds)
-                    hashes = self.prefix_cache.hashes(feats_np)
-                    # record=False: a deferred request re-probes every
-                    # round — stats count once, at claim success below
-                    hit_pages = self.prefix_cache.lookup(
-                        hashes, (t - 1) // self.page_size, record=False
-                    )
-                    pinned = hashes[: len(hit_pages)]
-                    self.prefix_cache.acquire(pinned)
-                need = self._need_pages(req)
-                free = free_pages()
-                if need > free and self.prefix_cache is not None:
-                    # pool pressure: surrender cold cached pages before
-                    # deferring (the cache is a best-effort tenant;
-                    # pinned chains are protected by live_users)
-                    free += self._evict_cached(need - free)
-                if need > free:
-                    if self.prefix_cache is not None:
-                        self.prefix_cache.release(pinned)  # not admitted
-                    if not any(r is not None for r in req_of):
-                        raise RuntimeError(
-                            "page pool exhausted: request needs "
-                            f"{need} pages but only {free} exist free — "
-                            "raise num_pages or lower concurrency"
-                        )
-                    break  # defer until an active request retires
-                queue.pop(0)
-                if self.prefix_cache is not None:
-                    self._slot_chain[slot] = pinned
-                    self.prefix_cache.record_admit(hit_pages)
-                batch.append((slot, rid, feats_np, t, hit_pages, hashes))
-                req_of[slot] = rid
+            def commit(slot, rid, req, need):
                 remaining[slot] = req.horizon
                 total_need[slot] = need
                 written[slot] = 0
+
+            batch = self._claim_admissions(
+                queue, results, req_of, free_pages, commit
+            )
             if batch:
                 with self._round(span, "admit", requests=len(batch)):
                     cold = [b for b in batch if not b[4]]

@@ -206,6 +206,19 @@ class BeholderService:
                     metrics=self.metrics.registry,
                 )
 
+        #: optional speculative-decoding config (``instance.spec.*``;
+        #: OFF by default). Like the serving prefix cache, the spec
+        #: subsystem is a LIBRARY feature — the service itself runs no
+        #: batcher — so the service's role is to parse the knob once and
+        #: hand the resulting :class:`beholder_tpu.spec.SpecConfig` to
+        #: whatever embeds a ContinuousBatcher next to the consumers
+        #: (``ContinuousBatcher(spec=service.spec)``). Parsing is
+        #: import-light (no jax) and, disabled, yields None — behavior
+        #: and the default exposition stay byte-identical.
+        from beholder_tpu.spec import spec_from_config
+
+        self.spec = spec_from_config(config)
+
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),

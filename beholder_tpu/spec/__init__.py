@@ -1,0 +1,173 @@
+"""Speculative decoding: draft-then-verify serving on the paged KV cache.
+
+EXTENSION BEYOND THE REFERENCE (which has no inference of any kind —
+SURVEY.md §0). The paged serving layer decodes one token per model step
+(:func:`beholder_tpu.models.serving.paged_decode_tick`), so decode
+throughput is bound by per-step latency. This subsystem turns the
+chunked dense-cache forward that PR 4 built for suffix prefill
+(:mod:`beholder_tpu.models.sequence`'s t>1 causal-offset path) into an
+N-tokens-per-step decode loop:
+
+1. a cheap DRAFTER proposes up to ``k`` future tokens per slot —
+   :class:`~beholder_tpu.spec.drafter.NGramDrafter` (suffix matching
+   over the request's own history; zero model cost) or
+   :class:`~beholder_tpu.spec.drafter.SmallModelDrafter` (a smaller
+   :class:`~beholder_tpu.models.sequence.TelemetrySequenceModel` with
+   its OWN paged slots);
+2. ONE verify step scores all ``k`` drafts for every slot at once
+   (:func:`~beholder_tpu.spec.verify.spec_verify_step`): the slot's
+   pages are gathered to a dense context and the ``k + 1``-wide chunk
+   runs through the existing dense-cache forward — causal within the
+   chunk, per-slot position offsets — while the chunk's KV is scattered
+   straight into freshly popped pages;
+3. the host accepts the longest agreeing draft prefix (greedy), or
+   rejection-samples under a temperature
+   (:func:`~beholder_tpu.spec.verify.speculative_sample` — provably
+   preserves the target distribution), emitting ``accepted + 1`` tokens
+   per verify step;
+4. the rejected suffix's pages are rolled back
+   (:func:`~beholder_tpu.spec.verify.paged_rollback`) — refcount-aware,
+   so pages shared with a fork or held by the prefix cache survive.
+
+**Greedy exactness.** With ``accept_tol == 0`` acceptance requires the
+draft to equal the verifier's own output BIT FOR BIT, and every emitted
+token is (bitwise) a verifier output conditioned on an exactly-verified
+prefix — so speculation ON emits the same token stream as speculation
+OFF (zero drafts, one verified token per step) REGARDLESS of drafter
+quality: a lying drafter only costs acceptance rate, never correctness
+(pinned by ``tests/test_spec.py`` with an adversarial drafter,
+``np.array_equal``). Against the repo's dense reference rollout
+(``forecast_deltas``) the stream agrees to reduction-reassociation
+ULPs — the verify chunk is mathematically the sequential dense-cache
+decode with the same dtype mix, but its gathered context buffer is a
+different width, and XLA may reassociate a masked-softmax sum
+differently at different widths (observed 0-1 ULP per token; also
+pinned). ``accept_tol > 0`` is the throughput mode (typical-acceptance
+style): an accepted draft may sit within the tolerance of the model's
+prediction, and conditioning stays self-consistent because the
+verifier scored exactly the drafted inputs.
+
+Everything is opt-in: no batcher drafts unless constructed with
+``spec=`` (:func:`spec_from_config` parses ``instance.spec.*``; the
+knob is OFF by default), and with spec off serving behavior and the
+default /metrics exposition are byte-identical to the non-speculative
+paths. This module stays import-light (no jax) — the device half lives
+in :mod:`.verify`/:mod:`.scheduler` and loads on first use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+#: acceptance modes
+MODE_GREEDY = "greedy"
+MODE_SAMPLE = "sample"
+
+#: drafter kinds buildable from config
+DRAFTER_NGRAM = "ngram"
+DRAFTER_MODEL = "model"
+DRAFTER_NONE = "none"
+
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knobs (``instance.spec.*``).
+
+    ``drafter`` may also be a :class:`~beholder_tpu.spec.drafter.Drafter`
+    INSTANCE (tests / the small-model drafter, which needs weights the
+    config can't carry)."""
+
+    mode: str = MODE_GREEDY        # greedy | sample
+    temperature: float = 0.0       # sample-mode proposal/target std dev
+    #: greedy acceptance tolerance. 0.0 = exact bitwise agreement (the
+    #: provable mode: spec on == spec off token for token); > 0 trades
+    #: bounded per-token drift for acceptance rate
+    accept_tol: float = 0.0
+    drafter: Any = DRAFTER_NGRAM   # "ngram" | "model" | "none" | Drafter
+    max_draft: int = 4             # k cap (the verify chunk is k+1 wide)
+    min_draft: int = 1
+    #: adaptive per-slot k from the observed acceptance EMA
+    adaptive: bool = True
+    ema: float = 0.9               # EMA decay for per-slot acceptance
+    #: n-gram drafter knobs
+    ngram_max_order: int = 3
+    ngram_match_tol: float = 0.0
+    #: sample-mode seed (None -> nondeterministic)
+    seed: int | None = None
+
+    def __post_init__(self):
+        if self.mode not in (MODE_GREEDY, MODE_SAMPLE):
+            raise ValueError(f"spec mode must be greedy|sample, got {self.mode!r}")
+        if self.mode == MODE_SAMPLE and self.temperature <= 0:
+            raise ValueError("sample mode needs temperature > 0")
+        if self.max_draft < 1:
+            raise ValueError(f"max_draft must be >= 1, got {self.max_draft}")
+        if not 1 <= self.min_draft <= self.max_draft:
+            raise ValueError(
+                f"min_draft must be in [1, max_draft={self.max_draft}], "
+                f"got {self.min_draft}"
+            )
+        if self.accept_tol < 0:
+            raise ValueError(f"accept_tol must be >= 0, got {self.accept_tol}")
+        if not 0 < self.ema < 1:
+            raise ValueError(f"ema must be in (0, 1), got {self.ema}")
+
+
+def spec_from_config(config) -> SpecConfig | None:
+    """Parse ``instance.spec.*`` into a :class:`SpecConfig`; None unless
+    ``instance.spec.enabled`` — the same off-by-default contract as the
+    cache and reliability subsystems (disabled means byte-identical
+    behavior and exposition)."""
+    if not bool(config.get("instance.spec.enabled")):
+        return None
+    seed = config.get("instance.spec.seed")
+    return SpecConfig(
+        mode=str(config.get("instance.spec.mode", MODE_GREEDY)),
+        temperature=float(config.get("instance.spec.temperature", 0.0)),
+        accept_tol=float(config.get("instance.spec.accept_tol", 0.0)),
+        drafter=str(config.get("instance.spec.drafter", DRAFTER_NGRAM)),
+        max_draft=int(config.get("instance.spec.max_draft", 4)),
+        min_draft=int(config.get("instance.spec.min_draft", 1)),
+        adaptive=bool(config.get("instance.spec.adaptive", True)),
+        ema=float(config.get("instance.spec.ema", 0.9)),
+        ngram_max_order=int(config.get("instance.spec.ngram.max_order", 3)),
+        ngram_match_tol=float(
+            config.get("instance.spec.ngram.match_tol", 0.0)
+        ),
+        seed=int(seed) if seed is not None else None,
+    )
+
+
+def __getattr__(name: str):
+    # heavy halves load lazily so `import beholder_tpu.spec` (and the
+    # service parsing its config) never pulls jax in
+    if name in ("Drafter", "NGramDrafter", "NullDrafter", "SmallModelDrafter"):
+        from . import drafter
+
+        return getattr(drafter, name)
+    if name in ("spec_verify_step", "paged_rollback", "greedy_accept",
+                "speculative_sample"):
+        from . import verify
+
+        return getattr(verify, name)
+    if name in ("run_spec", "AdaptiveDraftController"):
+        from . import scheduler
+
+        return getattr(scheduler, name)
+    if name == "SpecMetrics":
+        from .instruments import SpecMetrics
+
+        return SpecMetrics
+    raise AttributeError(name)
+
+
+__all__ = [
+    "SpecConfig",
+    "spec_from_config",
+    "MODE_GREEDY",
+    "MODE_SAMPLE",
+    "DRAFTER_NGRAM",
+    "DRAFTER_MODEL",
+    "DRAFTER_NONE",
+]

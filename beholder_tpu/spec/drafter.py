@@ -1,0 +1,351 @@
+"""Pluggable draft-token proposers for speculative decoding.
+
+A drafter proposes up to ``k`` future forecast tokens per slot; the
+verify step (:mod:`beholder_tpu.spec.verify`) scores them all in one
+model forward. Drafter quality only moves the ACCEPTANCE RATE — under
+greedy exact acceptance the emitted stream is identical to
+non-speculative decoding no matter what a drafter proposes (the
+structural guarantee ``tests/test_spec.py`` pins with a deliberately
+lying drafter).
+
+Two built-ins plus the degenerate one:
+
+- :class:`NGramDrafter` — the zero-cost default: greedy suffix matching
+  over the request's OWN history (observed telemetry deltas + already
+  emitted forecast tokens). Telemetry streams are self-similar —
+  encoders report near-constant progress rates for long stretches — so
+  the continuation of the latest matching suffix is a strong guess, and
+  proposing costs no model work at all (the counter-free-profiling
+  spirit: the signal is the data the request already carries).
+- :class:`SmallModelDrafter` — a smaller
+  :class:`~beholder_tpu.models.sequence.TelemetrySequenceModel` serving
+  drafts from its OWN paged slots (its own pool, its own page table,
+  the same serving primitives). After each verify the drafter resyncs
+  to the accepted stream: its speculated suffix is rolled back
+  page-aware (:func:`~beholder_tpu.spec.verify.paged_rollback`) and the
+  corrected token re-ingested.
+- :class:`NullDrafter` — proposes nothing; every verify step degrades
+  to a normal one-token decode through the verify path (the "normal
+  decode" member of a mixed batch).
+
+Host-side module: only :class:`SmallModelDrafter` touches a device, and
+it imports jax lazily so the package stays import-light.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Drafter:
+    """Interface. ``history`` is the request's full input-token stream
+    so far — observed feature deltas followed by emitted forecast
+    tokens, INCLUDING the pending last token (the one the next verify
+    chunk feeds first)."""
+
+    def on_admit(
+        self, slot: int, feats: np.ndarray, last_status: int
+    ) -> None:
+        """A request was admitted into ``slot``; ``feats`` is its
+        (t, F) prefix feature matrix."""
+
+    def propose(
+        self, slot: int, history: np.ndarray, k: int
+    ) -> np.ndarray:
+        """Up to ``k`` proposed continuations of ``history`` (may return
+        fewer, including none)."""
+        raise NotImplementedError
+
+    def resync(self, slot: int, history: np.ndarray) -> None:
+        """Called after each verify step with the slot's updated
+        history; stateful drafters roll their speculation back to the
+        accepted stream here."""
+
+    def on_retire(self, slot: int) -> None:
+        """The slot's request finished; drop any per-slot state."""
+
+
+class NullDrafter(Drafter):
+    """Proposes nothing — spec serving degenerates to one-token verify
+    steps (useful as a baseline and for mixed-batch tests)."""
+
+    def propose(self, slot: int, history: np.ndarray, k: int) -> np.ndarray:
+        return np.zeros(0, np.float32)
+
+
+class NGramDrafter(Drafter):
+    """Greedy n-gram / suffix-match drafting over the request's own
+    history.
+
+    For order ``max_order`` down to 1, the latest earlier occurrence of
+    the history's order-long suffix is located (values matched within
+    ``match_tol``; 0.0 = bitwise) and the tokens FOLLOWING that
+    occurrence are proposed. No match at any order falls back to
+    repeating the last token (order-0 — exactly right once a telemetry
+    stream's forecast has converged to a steady per-step delta, which is
+    where most of a long horizon's tokens live).
+
+    ``match_tol`` loosens MATCHING only; under greedy exact acceptance
+    the emitted stream is unaffected either way. Pair a small
+    ``match_tol``/``accept_tol`` (e.g. 1e-2 on ~1.0-scale deltas) to
+    draft through float jitter — the relaxed-acceptance throughput mode.
+    """
+
+    def __init__(
+        self,
+        max_order: int = 3,
+        match_tol: float = 0.0,
+        repeat_last_fallback: bool = True,
+        scan_window: int = 256,
+    ):
+        if max_order < 1:
+            raise ValueError(f"max_order must be >= 1, got {max_order}")
+        if scan_window < max_order + 1:
+            raise ValueError(
+                f"scan_window {scan_window} too small for order {max_order}"
+            )
+        self.max_order = int(max_order)
+        self.match_tol = float(match_tol)
+        self.repeat_last_fallback = bool(repeat_last_fallback)
+        #: drafting runs per slot per verify round on the host hot
+        #: loop, so matching is bounded to the most recent
+        #: ``scan_window`` tokens — telemetry self-similarity is local
+        #: (the steady-state delta the stream converged to), and an
+        #: unbounded scan would make each round O(history) and the
+        #: request O(history^2)
+        self.scan_window = int(scan_window)
+
+    def _find_suffix(self, history: np.ndarray, order: int) -> int | None:
+        """Index (into ``history``) AFTER the latest earlier occurrence
+        of the order-long suffix within the scan window, or None."""
+        base = max(0, history.shape[0] - self.scan_window)
+        recent = history[base:]
+        suffix = recent[-order:]
+        # windows[i] = recent[i : i + order], vectorized; candidates
+        # exclude the suffix's own position (the last window)
+        windows = np.lib.stride_tricks.sliding_window_view(recent, order)
+        if self.match_tol == 0.0:
+            hits = np.all(windows[:-1] == suffix, axis=1)
+        else:
+            hits = np.all(
+                np.abs(windows[:-1] - suffix) <= self.match_tol, axis=1
+            )
+        if not hits.any():
+            return None
+        start = int(np.nonzero(hits)[0][-1])  # latest occurrence
+        return base + start + order
+
+    def propose(self, slot: int, history: np.ndarray, k: int) -> np.ndarray:
+        history = np.asarray(history, np.float32)
+        if history.shape[0] == 0 or k <= 0:
+            return np.zeros(0, np.float32)
+        for order in range(
+            min(self.max_order, history.shape[0] - 1), 0, -1
+        ):
+            nxt = self._find_suffix(history, order)
+            if nxt is not None and nxt < history.shape[0]:
+                out = history[nxt : nxt + k]
+                if out.shape[0] < k:
+                    out = np.concatenate([
+                        out, np.full(k - out.shape[0], out[-1], np.float32)
+                    ])
+                return np.asarray(out, np.float32)
+        if self.repeat_last_fallback:
+            return np.full(k, history[-1], np.float32)
+        return np.zeros(0, np.float32)
+
+
+class SmallModelDrafter(Drafter):
+    """Draft with a smaller sequence model running on its OWN paged
+    slots.
+
+    The drafter owns a full
+    :class:`~beholder_tpu.models.serving.PagedKVState` (its own pool /
+    page table / free stack, sized for the DRAFT model's kv geometry)
+    and reuses the serving primitives: admission prefixes prefill via
+    :func:`~beholder_tpu.models.serving.paged_admit_batch`, each
+    proposal is one masked
+    :func:`~beholder_tpu.models.serving.paged_decode_tick`, and post-
+    verify resync rolls the speculated suffix back with
+    :func:`~beholder_tpu.spec.verify.paged_rollback` before the
+    corrected token is re-ingested — the same truncate-and-free
+    contract the target pool uses for rejected suffixes.
+
+    Per-slot host bookkeeping (``_inputs``) mirrors the input tokens
+    whose KV the drafter's cache holds, so resync is an exact
+    longest-common-prefix truncation (float comparisons are bitwise:
+    both sides carry the same f32 values).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        num_pages: int = 64,
+        page_size: int = 8,
+        slots: int = 4,
+        max_pages_per_seq: int = 32,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from beholder_tpu.models.serving import (
+            init_paged,
+            paged_admit_batch,
+            paged_release_many,
+        )
+        from beholder_tpu.ops import NUM_STATUSES
+        from beholder_tpu.spec.verify import paged_rollback, spec_verify_step
+
+        self.model = model
+        self.params = params
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.num_pages = int(num_pages)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.state = init_paged(
+            model, num_pages, page_size, slots, max_pages_per_seq
+        )
+        self._inputs: list[list[float]] = [[] for _ in range(slots)]
+        self._status = np.zeros(slots, np.int64)
+        self._num_statuses = NUM_STATUSES
+        self._jnp = jnp
+
+        def admit(p, s, slot_ids, feats, lens):
+            return paged_admit_batch(model, p, s, slot_ids, feats, lens)
+
+        def tick(p, s, token, status_oh, only):
+            # one draft step = a WIDTH-1 verify chunk on the drafter's
+            # own pool, masked to one slot. Going through the same
+            # gather -> chunked-forward -> scatter program family as
+            # the target's verifier keeps a same-architecture drafter
+            # bitwise-consistent with verification (the paged Pallas
+            # tick would differ by reassociation ULPs and read as
+            # near-zero acceptance under exact greedy matching)
+            active = jnp.arange(self.slots) == only
+            chunk = jnp.concatenate(
+                [jnp.broadcast_to(token, (self.slots,))[:, None], status_oh],
+                axis=-1,
+            )[:, None, :]                            # (slots, 1, F)
+            preds, new = spec_verify_step(model, p, s, chunk, active)
+            return preds[only, 0], new
+
+        def rollback(s, new_lens, active):
+            return paged_rollback(s, new_lens, active)
+
+        self._admit = jax.jit(admit)
+        self._tick = jax.jit(tick)
+        self._rollback = jax.jit(rollback)
+        self._release = jax.jit(paged_release_many)
+
+    # -- lifecycle -------------------------------------------------------
+    def on_admit(self, slot: int, feats: np.ndarray, last_status: int) -> None:
+        jnp = self._jnp
+        t = feats.shape[0]
+        # fail HERE, loudly, if the prefix alone can't fit the draft
+        # pool: the masked allocator would otherwise clip its pops and
+        # silently corrupt this pool's page table / refcounts (decode
+        # growth past the prefix is caught per round by the sticky
+        # alloc_failed check in propose())
+        need = -(-t // self.page_size)
+        if need > self.max_pages_per_seq or need > self.num_pages:
+            raise RuntimeError(
+                f"draft pool exhausted: a {t}-token prefix needs {need} "
+                f"pages (drafter pool {self.num_pages}, per-seq cap "
+                f"{self.max_pages_per_seq}) — size the SmallModelDrafter "
+                f"for the target batcher's workload"
+            )
+        pad = -(-t // self.page_size) * self.page_size
+        padded = np.pad(feats, ((0, pad - t), (0, 0)))[None]
+        if self._inputs[slot]:
+            self.on_retire(slot)
+        _, self.state = self._admit(
+            self.params, self.state,
+            jnp.asarray([slot], jnp.int32), jnp.asarray(padded),
+            jnp.asarray([t], jnp.int32),
+        )
+        self._inputs[slot] = [float(x) for x in feats[:, 0]]
+        self._status[slot] = int(last_status)
+
+    def on_retire(self, slot: int) -> None:
+        if self._inputs[slot]:
+            self.state = self._release(
+                self.state, self._jnp.asarray([slot], self._jnp.int32)
+            )
+            self._inputs[slot] = []
+
+    # -- drafting --------------------------------------------------------
+    def _status_oh(self) -> np.ndarray:
+        return np.eye(self._num_statuses, dtype=np.float32)[self._status]
+
+    def propose(self, slot: int, history: np.ndarray, k: int) -> np.ndarray:
+        jnp = self._jnp
+        if k <= 0 or not self._inputs[slot]:
+            return np.zeros(0, np.float32)
+        self.resync(slot, history)
+        inputs = self._inputs[slot]
+        pending = [float(x) for x in history[len(inputs):]]
+        oh = jnp.asarray(self._status_oh())
+        only = jnp.int32(slot)
+        preds = []
+        # ingest the tokens the drafter hasn't seen (>= 1: the pending
+        # emitted token); the LAST ingestion's output is proposal #1
+        pred = None
+        for token in pending:
+            pred, self.state = self._tick(
+                self.params, self.state, jnp.float32(token), oh, only
+            )
+            inputs.append(token)
+        if pred is None:  # fully in sync (shouldn't happen mid-run)
+            return np.zeros(0, np.float32)
+        preds.append(pred)
+        # self-fed rollout for the remaining k-1 proposals; the chain
+        # stays on device (pred is a device scalar), one stacked
+        # readback at the end
+        for _ in range(k - 1):
+            pred, self.state = self._tick(
+                self.params, self.state, pred, oh, only
+            )
+            preds.append(pred)
+        # ONE stacked readback for the proposals, with the draft pool's
+        # sticky allocator flag riding along: exhaustion mid-draft must
+        # surface as an error, not as silently corrupted drafter
+        # bookkeeping and collapsed acceptance
+        packed = np.asarray(
+            jnp.concatenate([
+                self.state.alloc_failed.astype(jnp.float32)[None],
+                jnp.stack(preds),
+            ]),
+            np.float32,
+        )
+        if packed[0]:
+            raise RuntimeError(
+                "draft pool exhausted mid-draft (drafter allocator "
+                "tripped) — raise the SmallModelDrafter's num_pages / "
+                "max_pages_per_seq"
+            )
+        out = packed[1:]
+        # the cache ingested proposals 1..k-1 as inputs (proposal k is
+        # output-only); mirror that host-side for resync
+        inputs.extend(float(x) for x in out[:-1])
+        return out
+
+    def resync(self, slot: int, history: np.ndarray) -> None:
+        jnp = self._jnp
+        inputs = self._inputs[slot]
+        keep = 0
+        limit = min(len(inputs), history.shape[0])
+        while keep < limit and inputs[keep] == float(history[keep]):
+            keep += 1
+        if keep < len(inputs):
+            # paged_rollback only reads new_lens where active, so a
+            # broadcast length + a one-hot mask needs no device read
+            active = np.zeros(self.slots, bool)
+            active[slot] = True
+            self.state = self._rollback(
+                self.state,
+                jnp.full((self.slots,), keep, jnp.int32),
+                jnp.asarray(active),
+            )
+            del inputs[keep:]

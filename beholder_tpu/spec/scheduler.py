@@ -1,0 +1,470 @@
+"""The speculative serving loop: ContinuousBatcher integration.
+
+:func:`run_spec` is the spec twin of
+:meth:`beholder_tpu.models.serving.ContinuousBatcher.run`: the same
+admission machinery (batched cold prefill, prefix-cache warm adoption,
+page-headroom arithmetic, pressure eviction, deferral) feeding a
+draft-then-verify decode loop instead of per-tick feedback:
+
+- every round, each active slot's drafter proposes up to ``k_s`` tokens
+  (``k_s`` tuned per slot by :class:`AdaptiveDraftController` from the
+  observed acceptance EMA);
+- ONE verify dispatch scores every slot's chunk at once
+  (:func:`~beholder_tpu.spec.verify.spec_verify_step`) — slots whose
+  drafter proposed nothing ride the same program as plain one-token
+  decodes, so mixed batches of verify chunks and normal decodes cost
+  one program either way;
+- ONE packed readback returns all predictions plus the sticky allocator
+  flag (the host needs the values anyway: acceptance, drafting and the
+  result streams are host-side in spec mode);
+- the host accepts per slot (greedy exact / tolerance, or
+  temperature-mode rejection sampling), then ONE rollback dispatch
+  truncates every rejected suffix
+  (:func:`~beholder_tpu.spec.verify.paged_rollback`).
+
+Per verify round that is 2-3 dispatches + 1 readback for
+``sum(accepted) + actives`` emitted tokens — against one dispatch per
+token for the non-spec tick loop. The trade against
+:meth:`~beholder_tpu.models.serving.ContinuousBatcher.run` is explicit:
+run() keeps the whole feedback loop on device with ZERO mid-flight
+readbacks, so on a high-latency tunnel spec only wins when the mean
+accepted length out-earns the per-round readback; where per-step model
+latency dominates (big models, local accelerators, CPU) spec wins at
+any acceptance > 0. ``bench.py --spec-only`` measures both on the same
+workload.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import (
+    DRAFTER_MODEL,
+    DRAFTER_NGRAM,
+    DRAFTER_NONE,
+    MODE_SAMPLE,
+    SpecConfig,
+)
+from .drafter import Drafter, NGramDrafter, NullDrafter
+
+
+class AdaptiveDraftController:
+    """Per-slot draft length from the observed acceptance EMA.
+
+    ``k = clip(round(a / (1 - a)), min, max)`` where ``a`` is the
+    slot's acceptance-rate EMA — the stationary-optimal draft length
+    for per-token acceptance probability ``a`` (the expected accepted
+    run is ``a/(1-a)``; drafting much past it wastes draft work, much
+    under it wastes verify steps). Tuning is observation-driven from
+    the same per-step outcomes the metric catalog exports — no device
+    reads, no extra instrumentation cost (the counter-free profiling
+    loop applied to itself)."""
+
+    def __init__(self, slots: int, cfg: SpecConfig):
+        self.min_k = cfg.min_draft
+        self.max_k = cfg.max_draft
+        self.adaptive = cfg.adaptive
+        self.decay = cfg.ema
+        self._init = 0.5
+        self.ema = np.full(slots, self._init, np.float64)
+
+    def choose(self, slot: int) -> int:
+        if not self.adaptive:
+            return self.max_k
+        a = float(self.ema[slot])
+        k = int(round(a / max(1e-6, 1.0 - a)))
+        return min(self.max_k, max(self.min_k, k))
+
+    def update(self, slot: int, drafted: int, accepted: int) -> None:
+        if drafted <= 0:
+            return
+        rate = accepted / drafted
+        self.ema[slot] = (
+            self.decay * self.ema[slot] + (1.0 - self.decay) * rate
+        )
+
+    def reset(self, slot: int) -> None:
+        self.ema[slot] = self._init
+
+
+def _build_drafter(batcher, cfg: SpecConfig) -> Drafter:
+    if isinstance(cfg.drafter, Drafter):
+        return cfg.drafter
+    if cfg.drafter == DRAFTER_NGRAM:
+        return NGramDrafter(
+            max_order=cfg.ngram_max_order, match_tol=cfg.ngram_match_tol
+        )
+    if cfg.drafter == DRAFTER_NONE:
+        return NullDrafter()
+    if cfg.drafter == DRAFTER_MODEL:
+        raise ValueError(
+            "drafter='model' needs a constructed SmallModelDrafter (a "
+            "draft model's weights can't come from config) — pass "
+            "SpecConfig(drafter=SmallModelDrafter(...))"
+        )
+    raise ValueError(f"unknown drafter {cfg.drafter!r}")
+
+
+def run_spec(batcher, requests: list) -> list[np.ndarray]:
+    """Serve ``requests`` speculatively on ``batcher``; results are the
+    same per-request forecast delta arrays ``run()`` returns. With
+    ``accept_tol == 0`` under greedy the stream is bitwise-independent
+    of the drafter (and tracks the dense reference rollout to
+    reassociation ULPs — see :mod:`beholder_tpu.spec`)."""
+    cfg: SpecConfig = batcher.spec
+    if cfg is None:
+        raise RuntimeError(
+            "batcher has no spec config — construct it with spec="
+        )
+    slots = batcher.slots
+
+    # persistent per-batcher collaborators (a drafter may hold its own
+    # paged state across calls; the controller's EMA carries over)
+    drafter = getattr(batcher, "_spec_drafter", None)
+    if drafter is None:
+        drafter = batcher._spec_drafter = _build_drafter(batcher, cfg)
+    controller = getattr(batcher, "_spec_controller", None)
+    if controller is None:
+        controller = batcher._spec_controller = AdaptiveDraftController(
+            slots, cfg
+        )
+    metrics = getattr(batcher, "_spec_metrics", None)
+    if metrics is None and batcher._registry is not None:
+        from .instruments import SpecMetrics
+
+        metrics = batcher._spec_metrics = SpecMetrics(batcher._registry)
+    rng = np.random.default_rng(cfg.seed)
+
+    # the shared fail-fast preamble (poison check, prefix cap, pool/
+    # table fit — _need_pages is already spec-aware, so the same checks
+    # cover the verify transient)
+    batcher._start_run(requests)
+
+    t0 = time.perf_counter()
+    try:
+        with batcher._run_span(
+            "serving.run_spec", requests=len(requests)
+        ) as span:
+            results = _run_spec_loop(
+                batcher, requests, cfg, drafter, controller, metrics,
+                rng, span,
+            )
+    except BaseException:
+        batcher._poisoned = True
+        raise
+    if batcher._metrics:
+        batcher._metrics.observe_run(
+            "run_spec",
+            time.perf_counter() - t0,
+            sum(max(r.horizon, 0) for r in requests),
+        )
+    return results
+
+
+def _run_spec_loop(
+    batcher, requests, cfg, drafter, controller, metrics, rng, span,
+):
+    # the jax-facing imports live here, at the one place they're used
+    # (run_spec itself is pure host bookkeeping)
+    import jax
+    import jax.numpy as jnp
+
+    from beholder_tpu.models.serving import (
+        paged_admit_batch,
+        paged_admit_with_prefix,
+    )
+    from beholder_tpu.ops import NUM_STATUSES
+
+    from .verify import (
+        greedy_accept,
+        paged_rollback,
+        spec_verify_step,
+        speculative_sample,
+    )
+
+    slots = batcher.slots
+    page = batcher.page_size
+    w = cfg.max_draft + 1
+    features = 1 + NUM_STATUSES
+    # page arithmetic rides the batcher's own accounting: _need_pages()
+    # (used by the shared claim loop) already budgets the max_draft-
+    # token verify transient when spec is configured, so the intake's
+    # shed costs, run()'s checks and this scheduler all agree on one
+    # worst case
+    queue = list(enumerate(requests))
+    results: list = [None] * len(requests)
+    sample_mode = cfg.mode == MODE_SAMPLE
+
+    req_of: list = [None] * slots
+    history: list[list[float]] = [[] for _ in range(slots)]
+    emitted: list[list[float]] = [[] for _ in range(slots)]
+    status_id = np.zeros(slots, np.int64)
+    cache_len = np.zeros(slots, np.int64)   # host mirror of seq_lens
+    total_need = np.zeros(slots, np.int64)
+    served = [0, 0]
+
+    status_eye = np.eye(NUM_STATUSES, dtype=np.float32)
+
+    verify_fn = batcher._cached_jit(
+        ("spec_verify", w),
+        lambda: lambda p, s, f, a: spec_verify_step(
+            batcher.model, p, s, f, a
+        ),
+    )
+    rollback_fn = batcher._cached_jit(
+        ("spec_rollback",),
+        lambda: lambda s, nl, a: paged_rollback(s, nl, a),
+    )
+
+    def free_pages() -> int:
+        cold = (
+            batcher.prefix_cache.cold_page_count
+            if batcher.prefix_cache is not None
+            else 0
+        )
+        return batcher.num_pages - int(total_need.sum()) - cold
+
+    def fetch_packed(preds_list):
+        """ONE readback: the sticky allocator flag + every pending
+        prediction, packed into one flat device buffer (the tunnel
+        charges d2h per BUFFER — same discipline as run())."""
+        packed = jnp.concatenate(
+            [batcher.state.alloc_failed.astype(jnp.float32)[None]]
+            + [jnp.asarray(p, jnp.float32).reshape(-1) for p in preds_list]
+        )
+        got = np.asarray(jax.device_get(packed), np.float32)
+        if got[0]:
+            raise RuntimeError(batcher._ALLOCATOR_TRIPPED)
+        return got[1:]
+
+    def retire(done: list[int]):
+        with batcher._round(span, "retire", slots=len(done)):
+            batcher.state = batcher._release_many(
+                batcher.state, jnp.asarray(done, jnp.int32)
+            )
+            for s in done:
+                rid = req_of[s]
+                results[rid] = np.asarray(
+                    emitted[s][: requests[rid].horizon], np.float32
+                )
+                served[0] += 1
+                served[1] += requests[rid].horizon
+                req_of[s] = None
+                history[s] = []
+                emitted[s] = []
+                total_need[s] = 0
+                cache_len[s] = 0
+                drafter.on_retire(s)
+                controller.reset(s)
+                if batcher.prefix_cache is not None and batcher._slot_chain[s]:
+                    batcher.prefix_cache.release(batcher._slot_chain[s])
+                    batcher._slot_chain[s] = []
+
+    while queue or any(r is not None for r in req_of):
+        # -- admission round: the CLAIM loop (pin prefix-cache hits
+        # before pressure eviction, defer when full, once-per-admission
+        # stats) is the batcher's own shared helper — one copy of the
+        # hardening invariants for run() and run_spec alike; what
+        # differs here is only the admit dispatch shape (one batched
+        # cold prefill + per-hit warm admits, ONE packed readback for
+        # the admit predictions)
+        def commit(slot, rid, req, need):
+            total_need[slot] = need
+
+        batch = batcher._claim_admissions(
+            queue, results, req_of, free_pages, commit
+        )
+        if batch:
+            with batcher._round(span, "admit", requests=len(batch)):
+                cold = [b for b in batch if not b[4]]
+                warm = [b for b in batch if b[4]]
+                preds_pending = []
+                pred_owner: list[int] = []
+                if cold:
+                    t_pad = -(
+                        -max(t for _, _, _, t, _, _ in cold) // page
+                    ) * page
+                    admit = batcher._cached_jit(
+                        ("spec_admit", len(cold), t_pad),
+                        lambda: lambda p, s, ids, f, ln: paged_admit_batch(
+                            batcher.model, p, s, ids, f, ln
+                        ),
+                    )
+                    preds, batcher.state = admit(
+                        batcher.params, batcher.state,
+                        jnp.asarray(
+                            [s for s, _, _, _, _, _ in cold], jnp.int32
+                        ),
+                        jnp.asarray(np.stack(
+                            [batcher._pad_to(f, t_pad)
+                             for _, _, f, _, _, _ in cold]
+                        )),
+                        jnp.asarray(
+                            [t for _, _, _, t, _, _ in cold], jnp.int32
+                        ),
+                    )
+                    preds_pending.append(preds)
+                    pred_owner.extend(s for s, _, _, _, _, _ in cold)
+                for slot, rid, feats_np, t, hit_pages, _ in warm:
+                    t_hit = len(hit_pages) * page
+                    s_len = t - t_hit
+                    s_pad = -(-s_len // page) * page
+                    admit_c = batcher._cached_jit(
+                        ("spec_admit_cached", len(hit_pages), s_pad),
+                        lambda: lambda p, s, sl, f, ln, pg: (
+                            paged_admit_with_prefix(
+                                batcher.model, p, s, sl, f, ln, pg
+                            )
+                        ),
+                    )
+                    pred, batcher.state = admit_c(
+                        batcher.params, batcher.state,
+                        jnp.int32(slot),
+                        jnp.asarray(
+                            batcher._pad_to(feats_np[t_hit:], s_pad)
+                        )[None],
+                        jnp.int32(s_len),
+                        jnp.asarray(hit_pages, jnp.int32),
+                    )
+                    preds_pending.append(pred.reshape(1))
+                    pred_owner.append(slot)
+                if batcher.prefix_cache is not None:
+                    batcher.prefix_cache.prefilled(sum(
+                        t - len(hp) * page
+                        for _, _, _, t, hp, _ in batch
+                    ))
+                    batcher._index_admitted([
+                        (slot, hs, t // page)
+                        for slot, _, _, t, _, hs in batch
+                    ])
+                admit_preds = fetch_packed(preds_pending)
+                pred_of = dict(zip(pred_owner, admit_preds))
+                for slot, rid, feats_np, t, _, _ in batch:
+                    status_id[slot] = int(requests[rid].statuses[-1])
+                    cache_len[slot] = t
+                    first = float(np.float32(pred_of[slot]))
+                    history[slot] = [float(x) for x in feats_np[:, 0]]
+                    history[slot].append(first)
+                    emitted[slot] = [first]
+                    drafter.on_admit(slot, feats_np, int(status_id[slot]))
+            done = [
+                b[0] for b in batch
+                if requests[b[1]].horizon <= len(emitted[b[0]])
+            ]
+            if done:
+                retire(done)
+
+        if batcher._metrics:
+            batcher._metrics.slots_active.set(
+                sum(r is not None for r in req_of)
+            )
+            batcher._metrics.pool_pages_free.set(free_pages())
+        if not any(r is not None for r in req_of):
+            continue
+
+        # -- draft round: per-slot proposals (zero-cost for the n-gram
+        # default; the model drafter runs its own paged ticks)
+        active = np.asarray([r is not None for r in req_of])
+        chunk = np.zeros((slots, w, features), np.float32)
+        drafts_of: dict[int, np.ndarray] = {}
+        means_of: dict[int, np.ndarray] = {}
+        chosen_k: list[int] = []
+        with batcher._round(span, "draft", slots=int(active.sum())):
+            for slot in range(slots):
+                if req_of[slot] is None:
+                    continue
+                # cap the draft at the slot's remaining tokens: a step
+                # emits up to k_s + 1, so drafting past remaining - 1
+                # would verify (and count) tokens no caller receives
+                remaining = requests[req_of[slot]].horizon - len(
+                    emitted[slot]
+                )
+                k_s = min(controller.choose(slot), max(remaining - 1, 0))
+                means = drafter.propose(
+                    slot, np.asarray(history[slot], np.float32), k_s
+                )[:k_s]
+                if sample_mode and means.shape[0]:
+                    drafts = np.asarray(
+                        means + cfg.temperature
+                        * rng.standard_normal(means.shape[0]),
+                        np.float32,
+                    )
+                else:
+                    drafts = means
+                drafts_of[slot] = drafts
+                means_of[slot] = means
+                chosen_k.append(k_s)
+                row = chunk[slot]
+                row[0, 0] = history[slot][-1]
+                row[1 : 1 + drafts.shape[0], 0] = drafts
+                row[:, 1:] = status_eye[status_id[slot]]
+        if metrics is not None and chosen_k:
+            metrics.draft_k.set(sum(chosen_k) / len(chosen_k))
+
+        # -- verify: ONE program for the whole mixed batch, ONE readback
+        with batcher._round(span, "verify", slots=int(active.sum())):
+            preds_dev, batcher.state = verify_fn(
+                batcher.params, batcher.state, jnp.asarray(chunk),
+                jnp.asarray(active),
+            )
+            preds = fetch_packed([preds_dev]).reshape(slots, w)
+
+        # -- host acceptance + rollback lengths
+        new_lens = np.zeros(slots, np.int64)
+        done = []
+        for slot in range(slots):
+            if req_of[slot] is None:
+                continue
+            drafts = drafts_of[slot]
+            k_s = drafts.shape[0]
+            if sample_mode:
+                m, toks = speculative_sample(
+                    preds[slot][: k_s + 1], means_of[slot], drafts,
+                    cfg.temperature, rng,
+                )
+            else:
+                m, toks = greedy_accept(
+                    drafts, preds[slot][: k_s + 1], cfg.accept_tol
+                )
+            old_end = cache_len[slot] + w
+            new_lens[slot] = cache_len[slot] + m + 1
+            freed = (-(-old_end // page)) - (-(-new_lens[slot] // page))
+            history[slot].extend(float(x) for x in toks)
+            emitted[slot].extend(float(x) for x in toks)
+            cache_len[slot] = new_lens[slot]
+            controller.update(slot, k_s, m)
+            if metrics is not None:
+                metrics.observe_step(k_s, m, toks.shape[0], int(freed))
+            rid = req_of[slot]
+            if len(emitted[slot]) >= requests[rid].horizon:
+                done.append(slot)
+            else:
+                # the documented Drafter contract: stateful drafters
+                # roll their speculation back to the accepted stream
+                # here (retiring slots skip straight to on_retire)
+                drafter.resync(
+                    slot, np.asarray(history[slot], np.float32)
+                )
+        with batcher._round(span, "rollback", slots=int(active.sum())):
+            batcher.state = rollback_fn(
+                batcher.state, jnp.asarray(new_lens, jnp.int32),
+                jnp.asarray(active),
+            )
+        if done:
+            retire(done)
+            if batcher._metrics:
+                batcher._metrics.slots_active.set(
+                    sum(r is not None for r in req_of)
+                )
+                batcher._metrics.pool_pages_free.set(free_pages())
+
+    # no trailing allocator check: every ALLOCATING dispatch (admit,
+    # verify) is immediately followed by a fetch_packed() that reads
+    # the sticky flag, and the only later dispatches (rollback,
+    # release) can only free pages — a final device_get would buy
+    # nothing and cost one d2h sync (~65 ms on the tunnel) per call
+    if batcher._metrics:
+        batcher._metrics.served(*served)
+    return results

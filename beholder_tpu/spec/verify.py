@@ -1,0 +1,291 @@
+"""Chunked draft verification + paged rollback (the device half).
+
+One verify step scores ``k`` draft tokens for EVERY slot in one model
+forward: the slot's resident pages are gathered into a dense per-layer
+context and the ``(slots, k + 1)`` input chunk runs through the
+existing dense-cache forward — the t>1 causal-offset path
+:mod:`beholder_tpu.models.sequence` grew for suffix prefill, here with
+PER-ROW position offsets (each slot sits at its own length). The
+chunk's KV is scattered straight into freshly popped pool pages in the
+same program, the slot tentatively advances ``k + 1`` tokens, and the
+host rolls the rejected suffix back with :func:`paged_rollback` once
+acceptance is known — truncation plus a refcount-aware free, so pages
+shared with a fork or pinned by the prefix cache are never reclaimed
+out from under their other owners.
+
+Numerics contract (what makes greedy spec PROVABLY lossless): an
+accepted draft is bitwise the verifier's own output, so drafting can
+change WHERE in a chunk a token gets computed but never WHAT is
+emitted — spec on == spec off token for token on a bf16 pool (pinned
+by ``tests/test_spec.py``). The loop is the sequential dense-cache
+decode mathematically (same einsum path, same bf16/f32 dtype mix;
+masked positions contribute exact zeros), and agrees with
+``forecast_deltas`` to reduction-reassociation ULPs — the gathered
+context buffer's width differs from the reference cache's, and XLA may
+reassociate a masked-softmax sum differently per width (observed 0-1
+ULP per token; int8 pools trade exactness for capacity, as everywhere
+else in the serving stack).
+
+Fusion note: allocation, gather, forward, scatter and the tentative
+length bump are ONE jitted program per chunk width — the
+draft-plus-verify step the scheduler dispatches is a single compiled
+unit (the transparent-fusion argument: the batcher composes subsystems
+without multiplying dispatches). The dense context gather does
+materialize (slots, Hkv, max_pages * page, Dh) per layer — the verify
+path's bandwidth is the same order as the paged tick's full-page reads,
+but unlike the tick it pays HBM for the view; spec is therefore a
+per-step-LATENCY lever (k tokens per dispatched step), not a bandwidth
+one.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from beholder_tpu.models.sequence import _pool_write_column
+from beholder_tpu.models.serving import (
+    PagedKVState,
+    _pool_geometry,
+    _pop_pages,
+    _unref_pages,
+)
+from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
+
+
+def _gather_dense(pool, page_table: jax.Array) -> jax.Array:
+    """(num_pages, Hkv, Dh, page) pool rows -> (slots, Hkv, P*page, Dh)
+    dense bf16 contexts via each slot's page table row (dequantized
+    under int8 pools) — the batched twin of ``paged_admit_with_prefix``'s
+    single-slot gather."""
+    if isinstance(pool, QuantizedPool):
+        vals = (
+            pool.values.astype(jnp.float32) * pool.scales[:, :, None, :]
+        ).astype(jnp.bfloat16)
+    else:
+        vals = pool.astype(jnp.bfloat16)
+    g = vals[page_table]                       # (S, P, Hkv, Dh, page)
+    s, p, hkv, dh, page = g.shape
+    return g.transpose(0, 2, 1, 4, 3).reshape(s, hkv, p * page, dh)
+
+
+def spec_verify_step(
+    model,
+    params,
+    state: PagedKVState,
+    chunk_feats: jax.Array,
+    active: jax.Array,
+):
+    """Score one ``(slots, W, F)`` input chunk against every slot's
+    paged context in ONE program; W = max draft + 1 (position 0 carries
+    the already-verified pending token, positions 1.. the drafts).
+
+    For each active slot: pop pages covering the W tentative writes,
+    gather its dense context, run the chunk through the per-row
+    causal-offset forward, scatter all W kv columns into the pool, and
+    advance ``seq_lens`` by W. Inactive slots ride along fully masked
+    (no pops, dropped writes, ignored outputs) — mixed batches of
+    verify chunks and plain decodes are just rows with different draft
+    fill. Returns ((slots, W) predictions, state); the host accepts a
+    prefix and calls :func:`paged_rollback` with the surviving lengths.
+    """
+    num_pages, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    s, w, _ = chunk_feats.shape
+    if s != slots:
+        raise ValueError(f"chunk batch {s} != slots {slots}")
+    lens = state.seq_lens
+    pos = lens[:, None] + jnp.arange(w)              # (S, W) write positions
+    # -- allocate: token j opens a page when its position hits a boundary
+    need = active[:, None] & (pos % page == 0)
+    pages, new_top, ref, failed = _pop_pages(state, need.reshape(-1))
+    pages = pages.reshape(s, w)
+    pidx = pos // page
+    failed = failed | jnp.any(need & (pidx >= max_pages))
+    rows = jnp.where(need, jnp.arange(s)[:, None], s)  # OOB row -> dropped
+    table = state.page_table.at[
+        rows, jnp.clip(pidx, 0, max_pages - 1)
+    ].set(pages, mode="drop")
+    state = state._replace(
+        page_table=table, free_top=new_top, page_ref=ref,
+        alloc_failed=failed,
+    )
+
+    # -- gather + chunked forward (per-row causal offsets at `lens`)
+    ks = tuple(_gather_dense(p, state.page_table) for p in state.k_pools)
+    vs = tuple(_gather_dense(p, state.page_table) for p in state.v_pools)
+    preds, kvs = model.apply(params, chunk_feats, cache=(ks, vs, lens))
+
+    # -- scatter the chunk's kv columns into the pool (all W tentatively;
+    # the host's rollback truncates the rejected suffix afterwards)
+    safe_pos = jnp.clip(pos, 0, max_pages * page - 1)
+    write_pages = jnp.where(
+        active[:, None],
+        table[jnp.arange(s)[:, None], jnp.clip(pidx, 0, max_pages - 1)],
+        num_pages,                                   # OOB -> dropped write
+    ).reshape(-1)
+    info = PagedInfo(
+        table, lens, write_pages, (pos % page).reshape(-1)
+    )
+    row_idx = jnp.arange(s)[:, None]
+    k_pools, v_pools = [], []
+    for layer, (k_dense, v_dense) in enumerate(kvs):
+        def cols(a):
+            # (S, Hkv, Lmax, Dh) -> the chunk's columns (S*W, Hkv, Dh)
+            c = a[row_idx, :, safe_pos, :]           # (S, W, Hkv, Dh)
+            return c.reshape(s * w, a.shape[1], a.shape[3])
+        k_pools.append(_pool_write_column(state.k_pools[layer], info, cols(k_dense)))
+        v_pools.append(_pool_write_column(state.v_pools[layer], info, cols(v_dense)))
+
+    state = state._replace(
+        k_pools=tuple(k_pools),
+        v_pools=tuple(v_pools),
+        seq_lens=lens + w * active.astype(jnp.int32),
+    )
+    return preds, state
+
+
+def paged_rollback(
+    state: PagedKVState, new_lens: jax.Array, active: jax.Array
+) -> PagedKVState:
+    """Truncate every active slot to ``new_lens[s]`` tokens (<= its
+    current length), returning pages wholly past the new end to the
+    free stack — ONE vectorized refcount-aware unref, so a page the
+    slot shares (a forked prefix, a prefix-cache-pinned page) survives
+    at refcount >= 1 and only the slot's exclusive fresh pages actually
+    free. Inactive slots are untouched. Used for rejected-suffix
+    rollback after verification and for the small-model drafter's
+    post-verify resync."""
+    _, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    old = state.seq_lens
+    first_dead = -(-new_lens // page)                  # ceil
+    n_old = -(-old // page)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (slots, max_pages), 1)
+    dead = (
+        active[:, None]
+        & (cols >= first_dead[:, None])
+        & (cols < n_old[:, None])
+    )
+    state = _unref_pages(
+        state, state.page_table.reshape(-1), dead.reshape(-1)
+    )
+    return state._replace(
+        seq_lens=jnp.where(active, jnp.minimum(new_lens, old), old)
+    )
+
+
+# -- host-side acceptance ----------------------------------------------------
+
+
+def greedy_accept(
+    drafts: np.ndarray, preds: np.ndarray, tol: float = 0.0
+) -> tuple[int, np.ndarray]:
+    """Greedy accept-longest-prefix. ``preds`` are the verifier's
+    outputs for chunk positions 0..W-1 (``preds[i]`` is the model's
+    next token given the pending token and drafts[:i]); ``drafts`` the
+    k proposals. Returns (accepted count m, the m + 1 emitted tokens —
+    the accepted drafts plus the correction/bonus token ``preds[m]``).
+
+    With ``tol == 0`` acceptance demands bitwise agreement, and since
+    an accepted draft IS the verifier's output, every emitted token is
+    a verifier output conditioned on verified inputs — the stream is
+    exactly the non-speculative greedy stream. With ``tol > 0`` an
+    accepted draft may differ from the model's prediction by up to
+    ``tol`` (conditioning remains self-consistent: ``preds`` was scored
+    on the drafted inputs, so each emitted token is within ``tol`` of
+    the model's one-step prediction given the emitted prefix)."""
+    drafts = np.asarray(drafts, np.float32)
+    preds = np.asarray(preds, np.float32)
+    m = 0
+    emitted: list[float] = []
+    for i in range(drafts.shape[0]):
+        d, p = drafts[i], preds[i]
+        ok = (d == p) if tol == 0.0 else (
+            math.isfinite(float(d)) and abs(float(d) - float(p)) <= tol
+        )
+        if not ok:
+            break
+        emitted.append(float(d))
+        m += 1
+    emitted.append(float(preds[m]))
+    return m, np.asarray(emitted, np.float32)
+
+
+def _gauss_logpdf_ratio(x: float, mu_num: float, mu_den: float, tau: float) -> float:
+    """log( N(x; mu_num, tau) / N(x; mu_den, tau) ) — the shared-sigma
+    Gaussian ratio used by acceptance and residual sampling."""
+    return ((x - mu_den) ** 2 - (x - mu_num) ** 2) / (2.0 * tau * tau)
+
+
+def residual_sample(
+    mu_p: float, mu_q: float, tau: float, rng: np.random.Generator,
+    max_tries: int = 256,
+) -> float:
+    """Sample from the normalized residual ``max(0, p - q)`` for
+    ``p = N(mu_p, tau)``, ``q = N(mu_q, tau)`` by rejection: draw
+    ``y ~ p`` and keep it with probability ``1 - min(1, q(y)/p(y))``.
+    This is exact (the residual is bounded above by ``p`` pointwise);
+    the try cap only guards the degenerate ``mu_p == mu_q`` case, where
+    the residual has measure zero and a plain target sample is the
+    correct limit."""
+    for _ in range(max_tries):
+        y = float(rng.normal(mu_p, tau))
+        keep = 1.0 - math.exp(
+            min(0.0, _gauss_logpdf_ratio(y, mu_q, mu_p, tau))
+        )
+        if rng.random() < keep:
+            return y
+    return float(rng.normal(mu_p, tau))
+
+
+def speculative_sample(
+    preds: np.ndarray,
+    draft_means: np.ndarray,
+    drafts: np.ndarray,
+    tau: float,
+    rng: np.random.Generator,
+) -> tuple[int, np.ndarray]:
+    """Temperature-mode rejection sampling (Leviathan et al.'s
+    speculative sampling, over the shared-sigma Gaussians this
+    continuous token space induces). ``drafts[i] ~ N(draft_means[i],
+    tau)`` is the drafter's sampled token, ``preds[i]`` the target
+    model's mean given the drafted inputs; the target token
+    distribution at position i is ``N(preds[i], tau)``.
+
+    Each draft is accepted with probability
+    ``min(1, p(x)/q(x))``; the first rejection is replaced by a sample
+    from the normalized residual ``(p - q)+``, and full acceptance
+    earns a bonus sample from the target at the next position. By the
+    standard speculative-sampling identity
+    ``q(x) min(1, p(x)/q(x)) + P[reject] * (p(x) - q(x))+/Z = p(x)``
+    the emitted token at every position is distributed EXACTLY as a
+    direct target sample — drafter quality moves only the acceptance
+    rate (distribution pinned empirically by ``tests/test_spec.py``).
+
+    Returns (accepted count m, the m + 1 emitted tokens)."""
+    if tau <= 0:
+        raise ValueError(f"speculative_sample needs tau > 0, got {tau}")
+    drafts = np.asarray(drafts, np.float32)
+    draft_means = np.asarray(draft_means, np.float32)
+    preds = np.asarray(preds, np.float32)
+    emitted: list[float] = []
+    m = 0
+    for i in range(drafts.shape[0]):
+        x = float(drafts[i])
+        log_ratio = _gauss_logpdf_ratio(
+            x, float(preds[i]), float(draft_means[i]), tau
+        )
+        if math.log(max(rng.random(), 1e-300)) < min(0.0, log_ratio):
+            emitted.append(x)
+            m += 1
+            continue
+        emitted.append(
+            residual_sample(float(preds[i]), float(draft_means[i]), tau, rng)
+        )
+        return m, np.asarray(emitted, np.float32)
+    emitted.append(float(rng.normal(float(preds[m]), tau)))
+    return m, np.asarray(emitted, np.float32)
