@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec lint clean
+.PHONY: all proto native test bench bench-cache bench-spec perf-gate lint clean
 
 all: proto native
 
@@ -52,6 +52,19 @@ bench-cache:
 # bench_e2e.json)
 bench-spec:
 	python bench.py --spec-only
+
+# the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
+# self-compare is the wiring check (every ratio extractor must resolve
+# and every noise band must hold at ratio 1.0). CI runs the real
+# cross-run compare — committed baseline vs the artifact the CI bench
+# just produced (see .circleci/config.yml). Absolute msg/s and TFLOP/s
+# are reported in the verdict but never gated (BENCH_NOTES.md: ±30%
+# host swings).
+perf-gate:
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_e2e.json --current artifacts/bench_e2e.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_spec.json --current artifacts/bench_spec.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

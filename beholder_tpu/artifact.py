@@ -39,7 +39,10 @@ Schema (``validate`` is the authoritative checker)::
                 "singleflight_collapsed": 0.0},  # v3: cache counters
       "spec": {"drafted": 0.0, "accepted": 0.0, "rejected": 0.0,
                "rollbacks": 0.0,
-               "mean_accept_len": 0.0}  # v4: speculative decoding
+               "mean_accept_len": 0.0},  # v4: speculative decoding
+      "attribution": {"phase_ms_pcts": {...},
+                      "kernel_ceiling_fracs": {...},
+                      "stall_pct": 0.0}  # v5: flight-recorder roofline
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -64,6 +67,16 @@ accepted / rejected, rejected-suffix rollbacks, and ``mean_accept_len``
 tokens than it dispatched verify steps, the figure speculative decoding
 exists to move — the ``make bench-spec`` acceptance gate). v1-v3
 artifacts remain valid.
+
+Schema v5 (the flight-recorder PR): the run's roofline attribution
+rides along (:meth:`ArtifactRecorder.record_attribution`) — where the
+engine step's wall went (``phase_ms_pcts``), each kernel family's
+achieved fraction of the matmul ceiling MEASURED ON THE SAME HOST
+(``kernel_ceiling_fracs``), and the share of wall spent waiting
+(``stall_pct``). These are the environment-normalized ratios
+``beholder_tpu/tools/perf_gate.py`` gates on — absolute figures stay
+in the artifact as evidence but are never gated (BENCH_NOTES.md: ±30%
+host swings). v1-v4 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -75,7 +88,16 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
+
+#: v5: the attribution block's required shape (an empty summary is
+#: valid — a run that never armed the flight recorder still writes a
+#: v5 artifact)
+EMPTY_ATTRIBUTION = {
+    "phase_ms_pcts": {},
+    "kernel_ceiling_fracs": {},
+    "stall_pct": 0.0,
+}
 
 #: artifact key -> the counter family summed into it (across labels)
 RELIABILITY_COUNTERS = {
@@ -187,6 +209,7 @@ class ArtifactRecorder:
         self.spec: dict[str, float] = {key: 0.0 for key in SPEC_COUNTERS}
         self._spec_emitted = 0.0
         self._spec_steps = 0.0
+        self.attribution: dict[str, Any] = copy.deepcopy(EMPTY_ATTRIBUTION)
 
     def section(
         self,
@@ -284,6 +307,19 @@ class ArtifactRecorder:
             if counter is not None:
                 setattr(self, attr, getattr(self, attr) + float(counter.total()))
 
+    def record_attribution(self, summary: dict[str, Any]) -> None:
+        """Adopt one flight-recorder roofline summary
+        (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
+        ``attribution`` block. Last writer wins — a bench records the
+        summary of its headline serving scenario, not a sum (phase
+        percentages don't add across scenarios)."""
+        for key in EMPTY_ATTRIBUTION:
+            if key not in summary:
+                raise ValueError(f"attribution summary missing {key!r}")
+        self.attribution = copy.deepcopy(
+            {key: summary[key] for key in EMPTY_ATTRIBUTION}
+        )
+
     def to_dict(self) -> dict[str, Any]:
         outcome = "ok"
         if self.error is not None:
@@ -312,6 +348,7 @@ class ArtifactRecorder:
                     else 0.0
                 ),
             },
+            "attribution": copy.deepcopy(self.attribution),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -370,6 +407,14 @@ def record_spec(registry) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_spec(registry)
+
+
+def record_attribution(summary: dict) -> None:
+    """Adopt a flight-recorder roofline summary into the active
+    recorder's v5 ``attribution`` block; no-op without one (same
+    contract as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_attribution(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -445,6 +490,27 @@ def validate(obj: Any) -> None:
                         f"spec.{key} must be a number, "
                         f"got {spec.get(key)!r}"
                     )
+    if isinstance(version, int) and version >= 5:
+        # v5: flight-recorder roofline attribution is part of the
+        # evidence (the ratios the perf gate compares)
+        attribution = obj.get("attribution")
+        if not isinstance(attribution, dict):
+            problems.append("attribution must be a dict (schema v5+)")
+        else:
+            for key in ("phase_ms_pcts", "kernel_ceiling_fracs"):
+                section = attribution.get(key)
+                if not isinstance(section, dict) or not all(
+                    isinstance(v, (int, float)) for v in section.values()
+                ):
+                    problems.append(
+                        f"attribution.{key} must be a dict of numbers, "
+                        f"got {section!r}"
+                    )
+            if not isinstance(attribution.get("stall_pct"), (int, float)):
+                problems.append(
+                    "attribution.stall_pct must be a number, "
+                    f"got {attribution.get('stall_pct')!r}"
+                )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):
         problems.append("raw_timings must be a list")

@@ -24,6 +24,7 @@ from http.server import ThreadingHTTPServer
 from typing import Iterable
 
 from beholder_tpu.httpd import serve_routes
+from beholder_tpu.tracing import current_trace_id
 
 DEFAULT_PORT = 8000
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -187,6 +188,17 @@ class Histogram(_Labelled):
     observation, stamped with the active trace id when the observation
     happens inside a :class:`~beholder_tpu.tracing.Span` context — the
     cross-link that lets a latency outlier be looked up as a trace.
+
+    Observations made inside a trace also leave an EXEMPLAR behind —
+    per (label set, bucket), the most recent observation's trace id,
+    value, and timestamp (:meth:`exemplars`). That is the REVERSE link
+    of the observation log: the log answers "which trace produced this
+    raw sample", the exemplar answers "give me one trace for this slow
+    bucket" straight off the aggregated series, without replaying the
+    jsonl. Exemplars never render into the classic exposition (parity
+    stays byte-identical); callers that know the trace id already
+    (e.g. the serving scheduler's round instrumentation, whose spans
+    close before the observation lands) pass ``exemplar_trace_id=``.
     """
 
     def __init__(
@@ -203,14 +215,23 @@ class Histogram(_Labelled):
         # per label key: [per-bucket counts..., +Inf overflow count]
         self._counts: dict[tuple[str, ...], list[int]] = {}
         self._sums: dict[tuple[str, ...], float] = {}
+        # per label key: bucket index -> latest traced observation
+        self._exemplars: dict[tuple[str, ...], dict[int, dict]] = {}
         if not self.labelnames:
             self._counts[()] = [0] * (len(self.buckets) + 1)
             self._sums[()] = 0.0
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self,
+        value: float,
+        *,
+        exemplar_trace_id: str | None = None,
+        **labels: str,
+    ) -> None:
         key = self._key(labels)
         value = float(value)
         idx = bisect.bisect_left(self.buckets, value)
+        trace_id = exemplar_trace_id or current_trace_id()
         with self._lock:
             counts = self._counts.get(key)
             if counts is None:
@@ -218,7 +239,28 @@ class Histogram(_Labelled):
                 self._sums[key] = 0.0
             counts[idx] += 1
             self._sums[key] += value
-        _observation_record(self.name, value, dict(labels))
+            if trace_id is not None:
+                self._exemplars.setdefault(key, {})[idx] = {
+                    "trace_id": trace_id,
+                    "value": value,
+                    "ts_us": int(time.time() * 1e6),
+                }
+        _observation_record(self.name, value, dict(labels), trace_id)
+
+    def exemplars(self, **labels: str) -> dict[str, dict]:
+        """Latest traced observation per bucket for one label set, keyed
+        by the bucket's ``le`` rendering (``"+Inf"`` for the overflow
+        bucket): ``{"0.05": {"trace_id", "value", "ts_us"}, ...}`` — the
+        one-click link from a slow bucket to its flight-recorder /
+        span timeline."""
+        key = self._key(labels)
+        with self._lock:
+            found = dict(self._exemplars.get(key, ()))
+        out: dict[str, dict] = {}
+        for idx, ex in sorted(found.items()):
+            le = _fmt(self.buckets[idx]) if idx < len(self.buckets) else "+Inf"
+            out[le] = dict(ex)
+        return out
 
     def time(self, **labels: str) -> "_HistogramTimer":
         """Context manager observing the block's wall time in seconds."""
@@ -308,21 +350,40 @@ def configure_observation_log(path: str | None) -> None:
         _obs_file_path = None
 
 
-def _observation_record(metric: str, value: float, labels: dict) -> None:
+def flush_observation_log() -> None:
+    """Flush + close the cached observation-log handle (shutdown path:
+    the service calls this from ``close()`` so a short-lived run's tail
+    observations are on disk before the process exits; the next
+    observation transparently re-opens)."""
+    global _obs_file, _obs_file_path
+    with _obs_lock:
+        if _obs_file is not None:
+            try:
+                _obs_file.flush()
+                _obs_file.close()
+            except Exception:  # noqa: BLE001 - best effort on the way out
+                pass
+        _obs_file = None
+        _obs_file_path = None
+
+
+def _observation_record(
+    metric: str, value: float, labels: dict, trace_id: str | None = None
+) -> None:
     global _obs_file, _obs_file_path
     path = _obs_path or os.environ.get("METRICS_OBS_JSONL")
     if not path:
         return
     try:
-        from beholder_tpu.tracing import current_trace_id
-
         line = json.dumps(
             {
                 "ts_us": int(time.time() * 1e6),
                 "metric": metric,
                 "value": value,
                 "labels": labels,
-                "trace_id": current_trace_id(),
+                "trace_id": (
+                    trace_id if trace_id is not None else current_trace_id()
+                ),
             }
         )
         with _obs_lock:

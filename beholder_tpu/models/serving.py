@@ -73,6 +73,7 @@ import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
 from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
+from beholder_tpu.tracing import current_trace_id
 
 from .sequence import TelemetrySequenceModel
 
@@ -973,13 +974,31 @@ class _ServingMetrics:
         self.requests_dispatched_total.inc(n_requests)
         self.tokens_dispatched_total.inc(n_tokens)
 
-    def observe_round(self, phase: str, seconds: float) -> None:
-        self.round_seconds.observe(seconds, phase=phase)
+    def observe_round(
+        self, phase: str, seconds: float, trace_id: str | None = None
+    ) -> None:
+        # trace_id: exemplar cross-link — the round span closes before
+        # this observation lands, so the batcher passes the id it
+        # captured inside the span (a slow bucket is then one lookup
+        # from its flight-recorder timeline)
+        self.round_seconds.observe(
+            seconds, exemplar_trace_id=trace_id, phase=phase
+        )
 
-    def observe_run(self, mode: str, seconds: float, n_tokens: int) -> None:
-        self.run_seconds.observe(seconds, mode=mode)
+    def observe_run(
+        self,
+        mode: str,
+        seconds: float,
+        n_tokens: int,
+        trace_id: str | None = None,
+    ) -> None:
+        self.run_seconds.observe(
+            seconds, exemplar_trace_id=trace_id, mode=mode
+        )
         if n_tokens > 0:
-            self.token_seconds.observe(seconds / n_tokens, mode=mode)
+            self.token_seconds.observe(
+                seconds / n_tokens, exemplar_trace_id=trace_id, mode=mode
+            )
 
     def idle(self, num_pages: int) -> None:
         self.slots_active.set(0)
@@ -1058,6 +1077,19 @@ class ContinuousBatcher:
     one. Composes with ``prefix_cache`` (warm admits adopt cached
     pages; rollback never reclaims a shared page). Off (None, the
     default) nothing changes.
+
+    ``flight_recorder`` (a :class:`beholder_tpu.obs.FlightRecorder`)
+    arms the per-step engine timeline: every scheduling phase all three
+    schedulers run (claim, admit, draft, tick/wave, verify, readback,
+    rollback, retire) lands in the recorder's bounded ring with the
+    active trace id, plus instant markers for prefix-cache lookups,
+    pressure-deferral stalls, and spec accept/rollback outcomes; with
+    an attributor wired, dispatch phases are tagged with estimated
+    FLOPs and achieved-fraction-of-ceiling (``beholder_tpu.obs.
+    roofline``). Host clocks only — zero device reads, like the
+    metrics. Off (None, the default) serving output and the /metrics
+    exposition are byte-identical (pinned by
+    ``tests/test_flight_recorder.py``).
     """
 
     def __init__(
@@ -1078,6 +1110,7 @@ class ContinuousBatcher:
         max_pending_pages: int | None = None,
         prefix_cache=None,
         spec=None,
+        flight_recorder=None,
     ):
         self.model = model
         self.params = params
@@ -1142,6 +1175,10 @@ class ContinuousBatcher:
                     f"{type(spec).__name__}"
                 )
         self.spec = spec
+        #: optional flight recorder (obs subsystem): the bounded per-
+        #: step engine timeline. None (the default) records nothing and
+        #: leaves every path byte-identical.
+        self.flight_recorder = flight_recorder
         #: lazily built by the spec scheduler (a drafter may hold its
         #: own paged state across calls; the controller's EMA carries)
         self._spec_drafter = None
@@ -1286,52 +1323,78 @@ class ContinuousBatcher:
         (slot, rid, feats, t, hit_pages, hashes) tuples; raises when
         nothing is active and the head request can never fit."""
         batch: list[tuple[int, int, np.ndarray, int, list, list]] = []
-        for slot in range(self.slots):
-            if not queue or req_of[slot] is not None:
-                continue
-            rid, req = queue[0]
-            if req.horizon <= 0:
-                # forecast_deltas(horizon=0) returns an empty array;
-                # skip the prefill/alloc round-trip entirely
-                queue.pop(0)
-                results[rid] = np.zeros(0, np.float32)
-                continue
-            self._check_servable(req)
-            feats_np, t = self._prep_np(req)
-            hit_pages: list[int] = []
-            hashes: list[bytes] = []
-            pinned: list[bytes] = []
-            if self.prefix_cache is not None:
-                hashes = self.prefix_cache.hashes(feats_np)
-                hit_pages = self.prefix_cache.lookup(
-                    hashes, (t - 1) // self.page_size, record=False
-                )
-                pinned = hashes[: len(hit_pages)]
-                self.prefix_cache.acquire(pinned)
-            need = self._need_pages(req)
-            free = free_pages()
-            if need > free and self.prefix_cache is not None:
-                # pool pressure: surrender cold cached pages before
-                # deferring (the cache is a best-effort tenant; pinned
-                # chains are protected by live_users)
-                free += self._evict_cached(need - free)
-            if need > free:
+        # flight-recorder-only instrumentation: claim must NOT appear as
+        # a new round-histogram phase label (the recorder-off exposition
+        # is pinned byte-identical), so it records straight to the ring
+        fr = self.flight_recorder
+        claim_ts = time.time() if fr is not None else 0.0
+        claim_t0 = time.perf_counter()
+        claim_tid = current_trace_id() if fr is not None else None
+        try:
+            for slot in range(self.slots):
+                if not queue or req_of[slot] is not None:
+                    continue
+                rid, req = queue[0]
+                if req.horizon <= 0:
+                    # forecast_deltas(horizon=0) returns an empty array;
+                    # skip the prefill/alloc round-trip entirely
+                    queue.pop(0)
+                    results[rid] = np.zeros(0, np.float32)
+                    continue
+                self._check_servable(req)
+                feats_np, t = self._prep_np(req)
+                hit_pages: list[int] = []
+                hashes: list[bytes] = []
+                pinned: list[bytes] = []
                 if self.prefix_cache is not None:
-                    self.prefix_cache.release(pinned)  # not admitted
-                if not any(r is not None for r in req_of):
-                    raise RuntimeError(
-                        "page pool exhausted: request needs "
-                        f"{need} pages but only {free} exist free — "
-                        "raise num_pages or lower concurrency"
+                    hashes = self.prefix_cache.hashes(feats_np)
+                    hit_pages = self.prefix_cache.lookup(
+                        hashes, (t - 1) // self.page_size, record=False
                     )
-                break  # defer until an active request retires
-            queue.pop(0)
-            if self.prefix_cache is not None:
-                self._slot_chain[slot] = pinned
-                self.prefix_cache.record_admit(hit_pages)
-            batch.append((slot, rid, feats_np, t, hit_pages, hashes))
-            req_of[slot] = rid
-            commit(slot, rid, req, need)
+                    pinned = hashes[: len(hit_pages)]
+                    self.prefix_cache.acquire(pinned)
+                    if fr is not None:
+                        fr.instant(
+                            "prefix_lookup", trace_id=claim_tid, slot=slot,
+                            hit_pages=len(hit_pages),
+                        )
+                need = self._need_pages(req)
+                free = free_pages()
+                if need > free and self.prefix_cache is not None:
+                    # pool pressure: surrender cold cached pages before
+                    # deferring (the cache is a best-effort tenant; pinned
+                    # chains are protected by live_users)
+                    free += self._evict_cached(need - free)
+                if need > free:
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.release(pinned)  # not admitted
+                    if not any(r is not None for r in req_of):
+                        raise RuntimeError(
+                            "page pool exhausted: request needs "
+                            f"{need} pages but only {free} exist free — "
+                            "raise num_pages or lower concurrency"
+                        )
+                    if fr is not None:
+                        fr.instant(
+                            "stall", trace_id=claim_tid,
+                            reason="pressure_deferral", slot=slot,
+                            need=int(need), free=int(free),
+                        )
+                    break  # defer until an active request retires
+                queue.pop(0)
+                if self.prefix_cache is not None:
+                    self._slot_chain[slot] = pinned
+                    self.prefix_cache.record_admit(hit_pages)
+                batch.append((slot, rid, feats_np, t, hit_pages, hashes))
+                req_of[slot] = rid
+                commit(slot, rid, req, need)
+        finally:
+            if fr is not None:
+                fr.record(
+                    "claim", claim_ts, time.perf_counter() - claim_t0,
+                    trace_id=claim_tid, claimed=len(batch),
+                    queued=len(queue),
+                )
         return batch
 
     def _check_servable(self, req: Request):
@@ -1351,11 +1414,37 @@ class ContinuousBatcher:
             return nullcontext()
         return self._tracer.start_span(operation, tags=tags)
 
+    @staticmethod
+    def _span_trace_id(span) -> str | None:
+        """The 32-hex trace id of a run span (None for nullcontext) —
+        the exemplar link for observations made after the span closes."""
+        ctx = getattr(span, "context", None)
+        return f"{ctx.trace_id:032x}" if ctx is not None else None
+
+    def _kernel_tags(self, family: str, flops: float) -> dict:
+        """Roofline-attribution tags for one dispatch round — empty
+        unless the flight recorder is armed, so the bare path builds no
+        extra dict entries."""
+        if self.flight_recorder is None:
+            return {}
+        return self.flight_recorder.kernel_tags(family, flops)
+
+    def _flops_per_token(self, ctx: float) -> float:
+        from beholder_tpu.obs.roofline import model_flops_per_token
+
+        return model_flops_per_token(self.model, ctx)
+
     @contextmanager
     def _round(self, parent, phase: str, **tags):
         """One scheduling round: a child span under the run span plus a
-        ``round_duration_seconds{phase=...}`` observation. Host-side
+        ``round_duration_seconds{phase=...}`` observation, and — with a
+        flight recorder wired — one timeline event carrying the round's
+        tags (kernel-attribution tags included). The trace id is
+        captured INSIDE the child span so both the recorder event and
+        the histogram exemplar link to this round's span. Host-side
         clocks only — instrumentation adds zero device reads."""
+        fr = self.flight_recorder
+        ts = time.time() if fr is not None else 0.0
         t0 = time.perf_counter()
         cm = (
             self._tracer.start_span(
@@ -1364,12 +1453,17 @@ class ContinuousBatcher:
             if self._tracer is not None and parent is not None
             else nullcontext()
         )
+        trace_id = None
         try:
             with cm:
+                trace_id = current_trace_id()
                 yield
         finally:
+            dur = time.perf_counter() - t0
             if self._metrics is not None:
-                self._metrics.observe_round(phase, time.perf_counter() - t0)
+                self._metrics.observe_round(phase, dur, trace_id=trace_id)
+            if fr is not None:
+                fr.record(phase, ts, dur, trace_id=trace_id, **tags)
 
     def _start_run(self, requests: list[Request]):
         """Fail fast BEFORE anything is admitted: every per-request
@@ -1498,6 +1592,7 @@ class ContinuousBatcher:
                 "run",
                 time.perf_counter() - t0,
                 sum(max(r.horizon, 0) for r in requests),
+                trace_id=self._span_trace_id(span),
             )
         return results
 
@@ -1590,7 +1685,16 @@ class ContinuousBatcher:
                 queue, results, req_of, free_pages, commit
             )
             if batch:
-                with self._round(span, "admit", requests=len(batch)):
+                admit_tags = {"requests": len(batch)}
+                if self.flight_recorder is not None:
+                    # prefill FLOPs follow the uncached suffix tokens;
+                    # ctx ~ t/2 is the mean causal visibility
+                    admit_tags.update(self._kernel_tags("flash", sum(
+                        (t - len(hp) * self.page_size)
+                        * self._flops_per_token(t / 2.0)
+                        for _, _, _, t, hp, _ in batch
+                    )))
+                with self._round(span, "admit", **admit_tags):
                     cold = [b for b in batch if not b[4]]
                     warm = [b for b in batch if b[4]]
                     if cold:
@@ -1679,7 +1783,19 @@ class ContinuousBatcher:
                            if active[s])) - 1
             )
             write_idx = np.where(active, written, cap).astype(np.int32)
-            with self._round(span, "tick", ticks=n_chunk):
+            tick_tags = {"ticks": n_chunk}
+            if self.flight_recorder is not None:
+                lens = [
+                    len(requests[req_of[s]].progress) - 1 + int(written[s])
+                    for s in range(self.slots)
+                    if active[s]
+                ]
+                tick_tags.update(self._kernel_tags(
+                    "paged",
+                    n_chunk * len(lens)
+                    * self._flops_per_token(float(np.mean(lens))),
+                ))
+            with self._round(span, "tick", **tick_tags):
                 self.state, carry = self._tick_chunk(
                     self.params, self.state, carry, jnp.asarray(write_idx),
                     jnp.int32(n_chunk),
@@ -1786,6 +1902,7 @@ class ContinuousBatcher:
                 "run_waves",
                 time.perf_counter() - t0,
                 sum(max(r.horizon, 0) for r in requests),
+                trace_id=self._span_trace_id(span),
             )
         return results
 
@@ -1838,9 +1955,19 @@ class ContinuousBatcher:
             if not wave:
                 continue
 
-            with self._round(
-                span, "wave", requests=len(wave), horizon=horizon
-            ):
+            wave_tags = {"requests": len(wave), "horizon": horizon}
+            if self.flight_recorder is not None:
+                # one fused prefill + scan program: prefill FLOPs per
+                # member plus horizon-1 decode ticks at end-of-wave ctx
+                wave_tags.update(self._kernel_tags("paged", sum(
+                    (len(req.progress) - 1)
+                    * self._flops_per_token((len(req.progress) - 1) / 2.0)
+                    + (horizon - 1) * self._flops_per_token(
+                        len(req.progress) - 1 + horizon / 2.0
+                    )
+                    for _, req in wave
+                )))
+            with self._round(span, "wave", **wave_tags):
                 prepped = [self._prep_np(req) for _, req in wave]
                 t_pad = -(
                     -max(t for _, t in prepped) // self.page_size
@@ -2005,6 +2132,7 @@ class ContinuousBatcher:
             self._metrics.served(1, k * horizon)
             self._metrics.idle(self.num_pages)
             self._metrics.observe_run(
-                "what_if", time.perf_counter() - t0, k * horizon
+                "what_if", time.perf_counter() - t0, k * horizon,
+                trace_id=self._span_trace_id(span),
             )
         return np.asarray(out[:, :horizon], np.float32)

@@ -1,0 +1,60 @@
+"""Serving flight recorder + runtime roofline attribution.
+
+The observability tentpole's third layer (after PR 1's histograms/spans
+and the schema-versioned artifacts): per-step engine timelines
+(:mod:`.recorder`), kernel attribution against ceilings measured on the
+same host (:mod:`.roofline`), exported as Chrome trace-event JSON by
+:mod:`beholder_tpu.tools.trace_export` and gated drift-proof by
+:mod:`beholder_tpu.tools.perf_gate`.
+
+Like the cache and spec subsystems, this is a LIBRARY feature behind a
+config knob the service merely parses: ``instance.observability.
+flight_recorder.*`` yields a :class:`FlightRecorder` (or None when
+disabled — the default, under which serving output and the /metrics
+exposition stay byte-identical) for whatever embeds a
+``ContinuousBatcher(flight_recorder=...)``.
+"""
+
+from __future__ import annotations
+
+from .recorder import DEFAULT_RING_SIZE, FlightRecorder
+from .roofline import (
+    PHASE_FAMILIES,
+    RooflineAttributor,
+    attribution_summary,
+    model_flops_per_token,
+)
+
+__all__ = [
+    "DEFAULT_RING_SIZE",
+    "FlightRecorder",
+    "PHASE_FAMILIES",
+    "RooflineAttributor",
+    "attribution_summary",
+    "flight_recorder_from_config",
+    "model_flops_per_token",
+]
+
+
+def flight_recorder_from_config(config) -> FlightRecorder | None:
+    """Build the flight recorder from ``instance.observability.
+    flight_recorder.*`` config, or None when disabled (the default).
+
+    Keys: ``enabled`` (bool), ``ring_size`` (int, default 4096 — the
+    bounded event memory), ``export_path`` (str; the service dumps the
+    ring there on shutdown), ``ceiling_interval_s`` (float, default
+    300 — how often the roofline attributor re-measures this host's
+    matmul/memcpy ceilings; <= 0 disables attribution entirely).
+    """
+    node = config.get("instance.observability.flight_recorder")
+    if node is None or not node.get("enabled"):
+        return None
+    interval = float(node.get("ceiling_interval_s", 300.0))
+    attributor = (
+        RooflineAttributor(interval_s=interval) if interval > 0 else None
+    )
+    return FlightRecorder(
+        ring_size=int(node.get("ring_size", DEFAULT_RING_SIZE)),
+        attributor=attributor,
+        export_path=node.get("export_path"),
+    )

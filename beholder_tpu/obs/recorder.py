@@ -1,0 +1,172 @@
+"""The serving flight recorder: a bounded ring of engine phase events.
+
+PR 1's histograms answer "how slow"; this answers "where the step
+went". Every scheduling phase the :class:`~beholder_tpu.models.serving.
+ContinuousBatcher` runs (claim, admit, draft, tick/wave dispatch,
+verify, readback — the device wait on this async runtime — rollback,
+retire) lands here as one timed event, plus instant markers for the
+things a timeline must show but a histogram can't (prefix-cache
+lookups, pressure-deferral stalls, spec accept/reject outcomes).
+
+Design constraints, in order:
+
+- **Bounded memory.** The ring is a ``deque(maxlen=ring_size)`` —
+  a week-long serving run holds the LAST ``ring_size`` events and a
+  count of what fell off (``dropped``), never an unbounded list.
+- **Zero cost when off.** The recorder is opt-in
+  (``ContinuousBatcher(flight_recorder=...)`` /
+  ``instance.observability.flight_recorder.enabled``); with it off the
+  serving path takes no extra syscalls and serving output plus the
+  /metrics exposition are byte-identical (pinned by
+  ``tests/test_flight_recorder.py``).
+- **Host clocks only.** Like the serving metrics, recording adds ZERO
+  device reads — an event's duration is the host-observed wall of the
+  phase (on an async backend the dispatch phases measure enqueue time
+  and the ``readback`` phase carries the device wait; the roofline
+  summary re-apportions it — see :mod:`beholder_tpu.obs.roofline`).
+- **Trace-linked.** Each event carries the trace id active when it was
+  recorded (:func:`beholder_tpu.tracing.current_trace_id`), the same id
+  the span reports and the metrics observation log carry — one key
+  joins exposition outliers, span timelines, and this recorder.
+
+Events export as JSON lines (:meth:`FlightRecorder.dump`) and convert
+to Chrome trace-event JSON via :mod:`beholder_tpu.tools.trace_export`
+(loadable in Perfetto / chrome://tracing).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from beholder_tpu.tracing import current_trace_id
+
+DEFAULT_RING_SIZE = 4096
+
+
+class FlightRecorder:
+    """Bounded ring buffer of serving phase events.
+
+    ``attributor`` (a :class:`~beholder_tpu.obs.roofline.
+    RooflineAttributor`) arms record-time kernel attribution: a
+    dispatch event recorded with ``family=``/``flops=`` tags (see
+    :meth:`kernel_tags`) gets a ``ceiling_frac`` — achieved fraction of
+    the host's MEASURED matmul ceiling — stamped into its args.
+
+    ``export_path`` is where :meth:`dump` writes by default (the
+    ``instance.observability.flight_recorder.export_path`` knob; the
+    service dumps on shutdown when set).
+    """
+
+    def __init__(
+        self,
+        ring_size: int = DEFAULT_RING_SIZE,
+        attributor=None,
+        export_path: str | None = None,
+    ):
+        if ring_size < 1:
+            raise ValueError(f"ring_size must be >= 1, got {ring_size}")
+        self.ring_size = ring_size
+        self.attributor = attributor
+        self.export_path = export_path
+        self.dropped = 0
+        self._ring: deque[dict[str, Any]] = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+
+    # -- recording -------------------------------------------------------
+
+    def record(
+        self,
+        name: str,
+        ts_s: float,
+        dur_s: float,
+        trace_id: str | None = None,
+        **args: Any,
+    ) -> None:
+        """One complete (``ph="X"``) phase event: epoch start ``ts_s``
+        (seconds), host-measured ``dur_s``; ``trace_id`` defaults to
+        the active span's. Dispatch events carrying :meth:`kernel_tags`
+        get their ``ceiling_frac`` stamped here."""
+        if trace_id is None:
+            trace_id = current_trace_id()
+        if (
+            self.attributor is not None
+            and "family" in args
+            and args.get("flops")
+        ):
+            args["ceiling_frac"] = self.attributor.observe(
+                args["family"], float(args["flops"]), dur_s
+            )
+        self._append(
+            {
+                "name": name,
+                "ph": "X",
+                "ts_us": int(ts_s * 1e6),
+                "dur_us": int(dur_s * 1e6),
+                "trace_id": trace_id,
+                "args": args,
+            }
+        )
+
+    def instant(
+        self, name: str, trace_id: str | None = None, **args: Any
+    ) -> None:
+        """A zero-duration marker (``ph="i"``): stalls, accept/reject
+        outcomes, cache lookups. ``trace_id`` defaults to the active
+        span's."""
+        self._append(
+            {
+                "name": name,
+                "ph": "i",
+                "ts_us": int(time.time() * 1e6),
+                "trace_id": (
+                    trace_id if trace_id is not None else current_trace_id()
+                ),
+                "args": args,
+            }
+        )
+
+    def kernel_tags(self, family: str, flops: float) -> dict[str, Any]:
+        """Tags that mark a dispatch event for roofline attribution:
+        kernel ``family`` (``flash`` prefill / ``paged`` decode /
+        ``verify`` spec chunks) and the dispatch's estimated FLOPs."""
+        return {"family": family, "flops": float(flops)}
+
+    def _append(self, event: dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._ring) == self.ring_size:
+                self.dropped += 1
+            self._ring.append(event)
+
+    # -- introspection / export -----------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def events(self) -> list[dict[str, Any]]:
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def dump(self, path: str | None = None) -> str:
+        """Write the ring as JSON lines (one event per line) to ``path``
+        (default: ``export_path``); returns the path written. The
+        export is the input format of
+        ``python -m beholder_tpu.tools.trace_export``."""
+        path = path or self.export_path
+        if not path:
+            raise ValueError("no path given and no export_path configured")
+        events = self.events()
+        with open(path, "w") as f:
+            for event in events:
+                f.write(json.dumps(event, default=str) + "\n")
+        return path

@@ -1,0 +1,304 @@
+"""Runtime roofline attribution: ceilings measured on THIS host, now.
+
+BENCH_NOTES.md's ground truth is that absolute numbers on this shared
+host swing ±30% with zero code changes — the r01→r02 "regression" was
+the machine. ROOFLINE.md's fix was to measure the chip's PRACTICAL
+matmul ceiling in the same session with the same harness and report
+every kernel as a fraction of it. This module brings that discipline to
+runtime: a :class:`RooflineAttributor` periodically re-measures the
+host's matmul and memcpy ceilings with the slope method (k chained
+calls + ONE readback, slope between two k's — the readback constant
+cancels, exactly ``bench.py``'s ``_slope_timeit``), then tags each
+recorded serving dispatch with its achieved fraction of that ceiling.
+
+The attribution is COUNTER-FREE (per the depthwise-convolution cloud
+paper's approach in PAPERS.md): no hardware counters, no device reads —
+everything derives from timing structure we control (phase walls the
+flight recorder already captures) normalized against ceilings measured
+on the same host minutes earlier. A dispatch at 0.4 of the measured
+ceiling is 0.4 on a fast day and 0.4 on a slow day; the absolute
+TFLOP/s is reported but never trusted across sessions.
+
+:func:`attribution_summary` folds one flight-recorder event stream into
+the artifact schema-v5 ``attribution`` block::
+
+    {"phase_ms_pcts":      {phase: % of recorded wall},
+     "kernel_ceiling_fracs": {family: achieved fraction of measured
+                              matmul ceiling, device-wait included},
+     "stall_pct":          % of recorded wall spent waiting on the
+                           device (top-level readback rounds + the
+                           spec loop's nested per-round device_wait
+                           slices)}
+
+On an async dispatch runtime a dispatch phase's own wall is mostly
+enqueue time; the device work hides inside the ``readback`` wait. The
+summary therefore charges each kernel family its dispatch wall PLUS a
+flops-prorated share of the readback wall — the structural estimate of
+device time available without a single device-side counter.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+#: the dispatch-phase -> kernel-family map the serving layer uses
+PHASE_FAMILIES = {
+    "admit": "flash",    # prefill: dense/flash-path forwards
+    "wave": "paged",     # fused admit+scan: decode-dominated
+    "tick": "paged",     # paged decode ticks
+    "verify": "verify",  # spec chunked verify forwards
+}
+
+
+def model_flops_per_token(model, ctx: float) -> float:
+    """Estimated forward FLOPs for ONE token of a
+    :class:`~beholder_tpu.models.sequence.TelemetrySequenceModel` at
+    context length ``ctx``: per layer the q/o projections (full width),
+    k/v projections (GQA-shrunk), the 4x dense MLP, and the two
+    attention matmuls over the context. An ESTIMATE for attribution —
+    the ratios the perf gate compares are insensitive to the constant,
+    as long as every session computes it the same way."""
+    d = float(model.dim)
+    heads = model.heads
+    kv = getattr(model, "kv_heads", None) or heads
+    proj = 2.0 * d * d * (2.0 + 2.0 * kv / heads)   # q, o + GQA k, v
+    mlp = 16.0 * d * d                              # up (4x) + down
+    attn = 4.0 * d * max(float(ctx), 1.0)           # scores + p·v
+    return model.layers * (proj + mlp + attn)
+
+
+def _slope_seconds(fn, k1: int, k2: int, rounds: int) -> float:
+    """Marginal per-call seconds of ``fn(prev) -> next``: k chained
+    calls + one scalar readback, min of each endpoint separately (the
+    bench harness's estimator — min-of-slopes is biased low)."""
+    import numpy as np
+
+    def run(k: int) -> float:
+        start = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = fn(out)
+        float(np.asarray(out).ravel()[0])
+        return time.perf_counter() - start
+
+    run(2)  # compile + warm
+    t1s = []
+    t2s = []
+    for _ in range(rounds):
+        t1s.append(run(k1))
+        t2s.append(run(k2))
+    return max((min(t2s) - min(t1s)) / (k2 - k1), 1e-12)
+
+
+class RooflineAttributor:
+    """Measures the host's matmul/memcpy ceilings (slope-timed, stale
+    after ``interval_s``) and converts (family, flops, wall) dispatch
+    observations into achieved-fraction-of-ceiling tags.
+
+    The measurement is deliberately small (``matmul_n``³ bf16-free f32
+    matmul, a few-MB element-wise pass) so a re-measure costs tens of
+    milliseconds — cheap enough to run inside an opt-in profiling mode,
+    big enough that the slope dominates dispatch noise."""
+
+    def __init__(
+        self,
+        interval_s: float = 300.0,
+        matmul_n: int = 256,
+        copy_mb: float = 4.0,
+    ):
+        import threading
+
+        self.interval_s = float(interval_s)
+        self.matmul_n = int(matmul_n)
+        self.copy_mb = float(copy_mb)
+        self._ceilings: dict[str, Any] | None = None
+        self._measuring = threading.Lock()
+        #: per-family accumulators: [flops, dispatch_wall_s, events]
+        self._families: dict[str, list[float]] = {}
+
+    # -- ceilings --------------------------------------------------------
+
+    def _stale(self) -> bool:
+        return (
+            self._ceilings is None
+            or time.time() - self._ceilings["measured_unix_s"]
+            > self.interval_s
+        )
+
+    def ceilings(self) -> dict[str, Any]:
+        """The current ceilings, re-measured SYNCHRONOUSLY when older
+        than ``interval_s`` (and measured lazily on first use —
+        construction stays import-light and device-free). Offline
+        callers (bench summaries, tests) use this; the serving hot path
+        goes through :meth:`ceilings_nowait`."""
+        if self._stale():
+            with self._measuring:
+                if self._stale():  # lost the race: another thread measured
+                    self._ceilings = self._measure()
+        return self._ceilings
+
+    def ceilings_nowait(self) -> dict[str, Any] | None:
+        """The cached ceilings without ever measuring inline — the
+        record-time path: a live scheduling round must not stall for
+        tens of ms of timing probes (let alone a first jit compile).
+        When stale, a background daemon thread re-measures (one at a
+        time) and the caller keeps the previous ceilings — or None
+        before the very first measurement lands, in which case
+        dispatches go untagged until it does."""
+        if self._stale() and self._measuring.acquire(blocking=False):
+            import threading
+
+            def measure_and_release():
+                try:
+                    self._ceilings = self._measure()
+                finally:
+                    self._measuring.release()
+
+            threading.Thread(
+                target=measure_and_release,
+                name="roofline-ceilings",
+                daemon=True,
+            ).start()
+        return self._ceilings
+
+    def _measure(self) -> dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        n = self.matmul_n
+        # ones/n is a fixed point of A @ A (each product entry is again
+        # 1/n), so the chain neither overflows nor constant-folds
+        a = jnp.full((n, n), 1.0 / n, jnp.float32)
+        mm = jax.jit(lambda x, y: x @ y)
+        per_mm = _slope_seconds(
+            lambda prev: mm(a if prev is None else prev, a), 4, 16, 3
+        )
+        buf = jnp.ones(max(1, int(self.copy_mb * 1e6 / 4)), jnp.float32)
+        bump = jax.jit(lambda x: x + 1.0)
+        per_copy = _slope_seconds(
+            lambda prev: bump(buf if prev is None else prev), 4, 16, 3
+        )
+        return {
+            "matmul_flops_per_s": 2.0 * n**3 / per_mm,
+            "memcpy_bytes_per_s": 2.0 * buf.nbytes / per_copy,
+            "matmul_n": n,
+            "copy_bytes": int(buf.nbytes),
+            "measured_unix_s": time.time(),
+        }
+
+    # -- observation -----------------------------------------------------
+
+    def observe(self, family: str, flops: float, dur_s: float) -> float:
+        """Record one dispatch and return its achieved fraction of the
+        measured matmul ceiling over its OWN wall (on an async runtime
+        this is a dispatch-wall figure; :func:`attribution_summary`
+        recomputes with the readback wait folded in). Never measures
+        inline — this runs in the serving loop, so a stale ceiling
+        re-measures in the background and the first dispatches before
+        any measurement report 0.0 (untagged is honest; stalled is
+        not)."""
+        acc = self._families.setdefault(family, [0.0, 0.0, 0])
+        acc[0] += float(flops)
+        acc[1] += float(dur_s)
+        acc[2] += 1
+        ceilings = self.ceilings_nowait()
+        if ceilings is None:
+            return 0.0
+        ceiling = ceilings["matmul_flops_per_s"]
+        if dur_s <= 0 or ceiling <= 0:
+            return 0.0
+        return round(float(flops) / dur_s / ceiling, 6)
+
+    def family_stats(self) -> dict[str, dict[str, float]]:
+        return {
+            family: {
+                "flops": acc[0],
+                "dispatch_wall_s": acc[1],
+                "events": acc[2],
+            }
+            for family, acc in sorted(self._families.items())
+        }
+
+
+def attribution_summary(
+    events: list[dict[str, Any]], ceilings: dict[str, Any] | None = None
+) -> dict[str, Any]:
+    """Fold a flight-recorder event stream into the artifact schema-v5
+    ``attribution`` block (see the module docstring for the shape).
+
+    ``ceilings`` is a :meth:`RooflineAttributor.ceilings` dict; without
+    one the family fractions fall back to the duration-weighted mean of
+    the ``ceiling_frac`` stamped on each dispatch at record time.
+
+    Stall accounting: ``readback`` is a TOP-LEVEL phase (run()/
+    run_waves() end-of-call device waits), while ``device_wait`` slices
+    are NESTED inside the spec loop's admit/verify rounds (the
+    per-round ``fetch_packed`` waits) — nested slices are excluded from
+    ``phase_ms_pcts``/the wall total (they'd double-count their parent)
+    but both feed ``stall_pct``, so a run whose rounds are mostly
+    waiting on the device reads as stalled regardless of which
+    scheduler produced it."""
+    all_slices = [e for e in events if e.get("ph") == "X"]
+    nested = [e for e in all_slices if e["name"] == "device_wait"]
+    slices = [e for e in all_slices if e["name"] != "device_wait"]
+    total_us = sum(int(e.get("dur_us", 0)) for e in slices)
+    phase_us: dict[str, int] = {}
+    for e in slices:
+        phase_us[e["name"]] = phase_us.get(e["name"], 0) + int(
+            e.get("dur_us", 0)
+        )
+    phase_ms_pcts = {
+        name: round(100.0 * us / total_us, 2) if total_us else 0.0
+        for name, us in sorted(phase_us.items())
+    }
+
+    readback_us = phase_us.get("readback", 0)
+    device_wait_us = sum(int(e.get("dur_us", 0)) for e in nested)
+    stall_pct = (
+        round(100.0 * (readback_us + device_wait_us) / total_us, 2)
+        if total_us
+        else 0.0
+    )
+
+    tagged = [
+        e
+        for e in slices
+        if e.get("args", {}).get("family") and e["args"].get("flops")
+    ]
+    fam_flops: dict[str, float] = {}
+    fam_us: dict[str, float] = {}
+    fam_frac_w: dict[str, float] = {}
+    for e in tagged:
+        fam = e["args"]["family"]
+        fam_flops[fam] = fam_flops.get(fam, 0.0) + float(e["args"]["flops"])
+        fam_us[fam] = fam_us.get(fam, 0.0) + float(e.get("dur_us", 0))
+        fam_frac_w[fam] = fam_frac_w.get(fam, 0.0) + float(
+            e["args"].get("ceiling_frac", 0.0)
+        ) * float(e.get("dur_us", 0))
+    total_tagged_flops = sum(fam_flops.values())
+
+    kernel_ceiling_fracs: dict[str, float] = {}
+    for fam in sorted(fam_flops):
+        if ceilings is not None and ceilings.get("matmul_flops_per_s"):
+            # device time ~= dispatch wall + flops-prorated readback wait
+            share = (
+                readback_us * fam_flops[fam] / total_tagged_flops
+                if total_tagged_flops
+                else 0.0
+            )
+            device_s = (fam_us[fam] + share) / 1e6
+            frac = (
+                fam_flops[fam] / device_s / ceilings["matmul_flops_per_s"]
+                if device_s > 0
+                else 0.0
+            )
+        else:
+            frac = fam_frac_w[fam] / fam_us[fam] if fam_us[fam] else 0.0
+        kernel_ceiling_fracs[fam] = round(frac, 4)
+
+    return {
+        "phase_ms_pcts": phase_ms_pcts,
+        "kernel_ceiling_fracs": kernel_ceiling_fracs,
+        "stall_pct": stall_pct,
+    }

@@ -219,6 +219,19 @@ class BeholderService:
 
         self.spec = spec_from_config(config)
 
+        #: optional serving flight recorder (``instance.observability.
+        #: flight_recorder.*``; OFF by default). A library knob like
+        #: ``spec``: the service parses it once into a
+        #: :class:`beholder_tpu.obs.FlightRecorder` for whatever embeds
+        #: a ContinuousBatcher (``flight_recorder=service.
+        #: flight_recorder``); on shutdown the service dumps the ring to
+        #: the configured ``export_path`` so short-lived runs keep their
+        #: timeline. Disabled it is None — serving behavior and the
+        #: default exposition stay byte-identical.
+        from beholder_tpu.obs import flight_recorder_from_config
+
+        self.flight_recorder = flight_recorder_from_config(config)
+
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),
@@ -370,7 +383,9 @@ class BeholderService:
         return traced_handler
 
     def close(self) -> None:
-        """Graceful teardown: stop consuming, drain analytics, close."""
+        """Graceful teardown: stop consuming, drain analytics, flush the
+        observability tail (open spans, raw observations, the flight-
+        recorder ring), close."""
         self.logger.info("shutting down")
         self.broker.close()
         if self.analytics is not None:
@@ -381,6 +396,29 @@ class BeholderService:
                 pass
         if self.health is not None:
             self.health.close()
+        # observability tail: a SIGTERM'd short-lived run must not drop
+        # its last spans/observations/timeline (main() routes SIGTERM
+        # here). Every step is best-effort — teardown always completes.
+        if self.tracer is not None:
+            try:
+                flushed = self.tracer.flush()
+                if flushed:
+                    self.logger.info(
+                        "flushed %d open trace span(s) at shutdown", flushed
+                    )
+            except Exception:  # noqa: BLE001
+                pass
+        from beholder_tpu.metrics import flush_observation_log
+
+        flush_observation_log()
+        if (
+            self.flight_recorder is not None
+            and self.flight_recorder.export_path
+        ):
+            try:
+                self.flight_recorder.dump()
+            except Exception:  # noqa: BLE001
+                pass
         self.metrics.close()
         self.db.close()
 

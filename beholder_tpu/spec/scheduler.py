@@ -159,6 +159,7 @@ def run_spec(batcher, requests: list) -> list[np.ndarray]:
             "run_spec",
             time.perf_counter() - t0,
             sum(max(r.horizon, 0) for r in requests),
+            trace_id=batcher._span_trace_id(span),
         )
     return results
 
@@ -229,12 +230,25 @@ def _run_spec_loop(
     def fetch_packed(preds_list):
         """ONE readback: the sticky allocator flag + every pending
         prediction, packed into one flat device buffer (the tunnel
-        charges d2h per BUFFER — same discipline as run())."""
+        charges d2h per BUFFER — same discipline as run()). The
+        device_get is the spec loop's DEVICE WAIT (it happens inside
+        the admit/verify rounds, not as a separate readback round), so
+        the flight recorder gets a nested ``device_wait`` slice —
+        attribution's stall accounting needs it, and the timeline
+        shows the wait inside its round."""
         packed = jnp.concatenate(
             [batcher.state.alloc_failed.astype(jnp.float32)[None]]
             + [jnp.asarray(p, jnp.float32).reshape(-1) for p in preds_list]
         )
+        fr = batcher.flight_recorder
+        ts = time.time() if fr is not None else 0.0
+        t0 = time.perf_counter()
         got = np.asarray(jax.device_get(packed), np.float32)
+        if fr is not None:
+            fr.record(
+                "device_wait", ts, time.perf_counter() - t0,
+                values=int(packed.shape[0]),
+            )
         if got[0]:
             raise RuntimeError(batcher._ALLOCATOR_TRIPPED)
         return got[1:]
@@ -277,7 +291,13 @@ def _run_spec_loop(
             queue, results, req_of, free_pages, commit
         )
         if batch:
-            with batcher._round(span, "admit", requests=len(batch)):
+            admit_tags = {"requests": len(batch)}
+            if batcher.flight_recorder is not None:
+                admit_tags.update(batcher._kernel_tags("flash", sum(
+                    (t - len(hp) * page) * batcher._flops_per_token(t / 2.0)
+                    for _, _, _, t, hp, _ in batch
+                )))
+            with batcher._round(span, "admit", **admit_tags):
                 cold = [b for b in batch if not b[4]]
                 warm = [b for b in batch if b[4]]
                 preds_pending = []
@@ -404,7 +424,18 @@ def _run_spec_loop(
             metrics.draft_k.set(sum(chosen_k) / len(chosen_k))
 
         # -- verify: ONE program for the whole mixed batch, ONE readback
-        with batcher._round(span, "verify", slots=int(active.sum())):
+        fr = batcher.flight_recorder
+        verify_tags = {"slots": int(active.sum())}
+        if fr is not None and active.any():
+            # each live slot scores a (k+1)-wide chunk against its
+            # paged context — the "verify" kernel family
+            verify_tags.update(batcher._kernel_tags(
+                "verify",
+                float(active.sum()) * w * batcher._flops_per_token(
+                    float(cache_len[active].mean())
+                ),
+            ))
+        with batcher._round(span, "verify", **verify_tags):
             preds_dev, batcher.state = verify_fn(
                 batcher.params, batcher.state, jnp.asarray(chunk),
                 jnp.asarray(active),
@@ -437,6 +468,18 @@ def _run_spec_loop(
             controller.update(slot, k_s, m)
             if metrics is not None:
                 metrics.observe_step(k_s, m, toks.shape[0], int(freed))
+            if fr is not None:
+                # the flight-recorder timeline shows the accept/reject
+                # STRUCTURE, not just the rate: one marker per slot per
+                # verify round, plus one per page-freeing rollback
+                fr.instant(
+                    "spec.accept", slot=slot, drafted=int(k_s),
+                    accepted=int(m), emitted=int(toks.shape[0]),
+                )
+                if freed > 0:
+                    fr.instant(
+                        "spec.rollback", slot=slot, freed_pages=int(freed)
+                    )
             rid = req_of[slot]
             if len(emitted[slot]) >= requests[rid].horizon:
                 done.append(slot)

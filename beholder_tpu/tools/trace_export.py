@@ -1,0 +1,156 @@
+"""Export flight-recorder events as Chrome trace-event JSON.
+
+The :class:`~beholder_tpu.obs.FlightRecorder` ring (or its
+:meth:`~beholder_tpu.obs.FlightRecorder.dump` JSONL) becomes one
+``{"traceEvents": [...]}`` document loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing`` — a whole serving run
+inspectable as a timeline: per-round phase slices (claim / admit /
+draft / tick / wave / verify / readback / rollback / retire), instant
+markers for prefix-cache lookups, pressure-deferral stalls and spec
+accept/rollback outcomes, and each dispatch's kernel family + achieved
+fraction of the host's measured matmul ceiling in its args.
+
+Rows: each distinct trace id (one scheduler call / consumer message)
+gets its own named track, so concurrent runs and the spans they cross-
+link to (``$TRACE_JSONL`` / the metrics observation log, keyed on the
+same trace id) line up visually. Untraced events share track 0.
+
+CLI::
+
+    python -m beholder_tpu.tools.trace_export events.jsonl -o trace.json
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+PROCESS_NAME = "beholder-serving"
+
+
+def load_events(path: str) -> list[dict[str, Any]]:
+    """Read a :meth:`FlightRecorder.dump` JSONL file (one event per
+    line; blank/corrupt lines are skipped, not fatal — a ring dumped
+    mid-crash must still export)."""
+    events: list[dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "name" in obj:
+                events.append(obj)
+    return events
+
+
+def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
+    """Convert recorder events to the Chrome trace-event format (JSON
+    Array Format with metadata, the Perfetto-compatible subset)."""
+    tid_of: dict[str, int] = {}
+
+    def tid(trace_id: str | None) -> int:
+        if not trace_id:
+            return 0
+        if trace_id not in tid_of:
+            tid_of[trace_id] = len(tid_of) + 1
+        return tid_of[trace_id]
+
+    trace_events: list[dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": PROCESS_NAME},
+        },
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": "untraced"},
+        },
+    ]
+    for event in events:
+        trace_id = event.get("trace_id")
+        row = tid(trace_id)
+        out: dict[str, Any] = {
+            "name": event["name"],
+            "ph": event.get("ph", "X"),
+            "ts": int(event.get("ts_us", 0)),
+            "pid": 1,
+            "tid": row,
+            "cat": "serving",
+            "args": {**event.get("args", {}), "trace_id": trace_id},
+        }
+        if out["ph"] == "X":
+            out["dur"] = int(event.get("dur_us", 0))
+        elif out["ph"] == "i":
+            out["s"] = "t"  # thread-scoped instant marker
+        trace_events.append(out)
+    # one named track per trace: the trace id prefix is enough to join
+    # against span reports without 32 hex chars of track label
+    for trace_id, row in tid_of.items():
+        trace_events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": row,
+                "args": {"name": f"trace {trace_id[:12]}"},
+            }
+        )
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def export(events_or_path, out_path: str) -> str:
+    """Write the Chrome trace for ``events_or_path`` (a recorder-event
+    list, a :class:`FlightRecorder`, or a dump JSONL path) to
+    ``out_path``; returns the path."""
+    if isinstance(events_or_path, str):
+        events = load_events(events_or_path)
+    elif hasattr(events_or_path, "events"):
+        events = events_or_path.events()
+    else:
+        events = list(events_or_path)
+    with open(out_path, "w") as f:
+        json.dump(chrome_trace(events), f, indent=1)
+        f.write("\n")
+    return out_path
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description=(
+            "Convert a flight-recorder JSONL dump to Chrome trace-event "
+            "JSON (load the output in https://ui.perfetto.dev)"
+        )
+    )
+    parser.add_argument("events", help="FlightRecorder.dump() JSONL path")
+    parser.add_argument(
+        "-o",
+        "--out",
+        default=None,
+        help="output path (default: <events>.trace.json)",
+    )
+    args = parser.parse_args(argv)
+    out = args.out or f"{args.events.removesuffix('.jsonl')}.trace.json"
+    events = load_events(args.events)
+    export(events, out)
+    slices = sum(1 for e in events if e.get("ph", "X") == "X")
+    instants = len(events) - slices
+    print(
+        f"wrote {out}: {slices} phase slices, {instants} instant markers "
+        f"from {args.events}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

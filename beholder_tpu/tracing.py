@@ -159,6 +159,7 @@ class Span:
         # monotonic delta: an NTP step between start and finish must not
         # corrupt (or negate) the one number tracing exists to measure
         self.duration_us = (time.perf_counter_ns() - self._t0_ns) // 1000
+        self._tracer._forget(self)
         self._tracer._report(self)
 
     @property
@@ -299,6 +300,11 @@ class Tracer:
         self.reporter = reporter if reporter is not None else InMemoryReporter()
         self.sample_rate = sample_rate
         self._rand = _rand or __import__("random").random
+        #: unfinished sampled spans, for the shutdown flush: a span open
+        #: when the process exits would otherwise never reach a reporter
+        #: (short-lived runs drop their tail)
+        self._live: set[Span] = set()
+        self._live_lock = threading.Lock()
 
     def start_span(
         self,
@@ -331,7 +337,27 @@ class Tracer:
             )
         if not ctx.sampled:
             return _NoopSpan(ctx)
-        return Span(self, operation, ctx, tags)
+        span = Span(self, operation, ctx, tags)
+        with self._live_lock:
+            self._live.add(span)
+        return span
+
+    def _forget(self, span: Span) -> None:
+        with self._live_lock:
+            self._live.discard(span)
+
+    def flush(self) -> int:
+        """Finish (and report) every span still open — the shutdown /
+        SIGTERM path: a consumer mid-message or a scheduler call cut off
+        by process exit reports a truncated-but-present span (tagged
+        ``flushed_at_shutdown``) instead of vanishing. Returns how many
+        spans were flushed; safe to call repeatedly."""
+        with self._live_lock:
+            open_spans = list(self._live)
+        for span in open_spans:
+            span.set_tag("flushed_at_shutdown", True)
+            span.finish()
+        return len(open_spans)
 
     def _report(self, span: Span) -> None:
         try:

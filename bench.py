@@ -1068,15 +1068,29 @@ def bench_spec() -> dict:
     Deliberately CPU-sized like :func:`bench_prefix_cache`: the claim
     is about scheduling and token accounting, so it runs in every bench
     tier including BENCH_QUICK — the committed bench_e2e.json always
-    carries a live mean-accept-length figure."""
+    carries a live mean-accept-length figure.
+
+    The spec-on pass runs with the FLIGHT RECORDER armed (tracer +
+    roofline attributor): the artifact's schema-v5 ``attribution``
+    block comes from this scenario's real event stream, and the ring is
+    dumped + exported as Chrome trace-event JSON under
+    ``artifacts/flight/`` — a committed, loadable timeline of a real
+    serving run, accept/rollback structure included."""
     import jax
     import numpy as np
 
     from beholder_tpu import metrics as metrics_mod
     from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
     from beholder_tpu.models.serving import ContinuousBatcher, Request
+    from beholder_tpu.obs import (
+        FlightRecorder,
+        RooflineAttributor,
+        attribution_summary,
+    )
     from beholder_tpu.proto import TelemetryStatusEntry
     from beholder_tpu.spec import SpecConfig
+    from beholder_tpu.tools import trace_export
+    from beholder_tpu.tracing import InMemoryReporter, Tracer
 
     page, slots = 8, 4
     prefix_t, horizon = 24, 64
@@ -1096,12 +1110,12 @@ def bench_spec() -> dict:
 
     requests = [mk_request(i) for i in range(n_requests)]
 
-    def mk_batcher(spec):
+    def mk_batcher(spec, **kwargs):
         return ContinuousBatcher(
             model, state.params,
             num_pages=128, page_size=page, slots=slots,
             max_prefix=prefix_t, max_pages_per_seq=16,
-            metrics=registry, spec=spec,
+            metrics=registry, spec=spec, **kwargs,
         )
 
     registry = metrics_mod.Registry()
@@ -1110,9 +1124,19 @@ def bench_spec() -> dict:
     off_results = baseline.run(requests)
     off_s = time.perf_counter() - t0
 
-    spec_batcher = mk_batcher(SpecConfig(
-        max_draft=4, accept_tol=accept_tol, adaptive=True
-    ))
+    # the spec-on pass is the run the flight recorder records: per-
+    # round phase slices, spec accept/rollback markers, and roofline-
+    # attributed dispatches, all trace-linked through the tracer
+    attributor = RooflineAttributor(interval_s=600.0)
+    attributor.ceilings()  # warm BEFORE serving: record-time tagging
+    # never measures inline, so a cold attributor leaves early
+    # dispatches at frac 0.0 (fine live, noise in a committed artifact)
+    recorder = FlightRecorder(ring_size=4096, attributor=attributor)
+    tracer = Tracer("bench", reporter=InMemoryReporter())
+    spec_batcher = mk_batcher(
+        SpecConfig(max_draft=4, accept_tol=accept_tol, adaptive=True),
+        flight_recorder=recorder, tracer=tracer,
+    )
     t0 = time.perf_counter()
     on_results = spec_batcher.run_spec(requests)
     on_s = time.perf_counter() - t0
@@ -1136,6 +1160,47 @@ def bench_spec() -> dict:
         for on, off in zip(on_results, off_results)
     )
     artifact.record_spec(registry)
+
+    # schema-v5 attribution + the committed timeline: summarize the
+    # real event stream, dump the ring, export the Chrome trace
+    summary = attribution_summary(recorder.events(), attributor.ceilings())
+    artifact.record_attribution(summary)
+    # flight exports live in a SUBDIRECTORY: every top-level
+    # artifacts/*.json must stay a schema-valid bench artifact
+    # (tests/test_artifact.py pins that contract)
+    out_dir = os.path.join(
+        os.environ.get("BENCH_ARTIFACT_DIR") or artifact.DEFAULT_DIR,
+        "flight",
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    events_path = recorder.dump(
+        os.path.join(out_dir, "flight_events_spec.jsonl")
+    )
+    trace_path = trace_export.export(
+        recorder.events(), os.path.join(out_dir, "trace_spec.json")
+    )
+    events = recorder.events()
+    flight = {
+        "events": len(events),
+        "dropped": recorder.dropped,
+        "spec_accept_markers": sum(
+            1 for e in events if e["name"] == "spec.accept"
+        ),
+        "spec_rollback_markers": sum(
+            1 for e in events if e["name"] == "spec.rollback"
+        ),
+        "events_path": events_path,
+        "trace_path": trace_path,
+        "attribution": summary,
+        "ceilings": {
+            "matmul_tflops": round(
+                attributor.ceilings()["matmul_flops_per_s"] / 1e12, 4
+            ),
+            "memcpy_gbytes_per_s": round(
+                attributor.ceilings()["memcpy_bytes_per_s"] / 1e9, 2
+            ),
+        },
+    }
     return {
         "metric": "spec_mean_accept_len",
         "value": round(mean_accept_len, 4),
@@ -1149,6 +1214,7 @@ def bench_spec() -> dict:
         "spec_off_tokens_per_sec": round(tokens / off_s, 1),
         "spec_on_tokens_per_sec": round(tokens / on_s, 1),
         "max_abs_dev_vs_exact": max_dev,
+        "flight_recorder": flight,
         "note": (
             f"{n_requests} x ({prefix_t}-prefix + {horizon}-horizon) "
             "decode-heavy mix; spec on = n-gram drafter, adaptive k <= "

@@ -1,0 +1,657 @@
+"""The serving flight recorder: bounded ring semantics, default-OFF
+byte-identical serving, trace-linked phase timelines through all three
+schedulers, Chrome trace export, runtime roofline attribution, the
+schema-v5 artifact block, and the ratio-only perf gate."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.metrics import Metrics
+from beholder_tpu.obs import (
+    FlightRecorder,
+    RooflineAttributor,
+    attribution_summary,
+    flight_recorder_from_config,
+    model_flops_per_token,
+)
+from beholder_tpu.tools import perf_gate, trace_export
+from beholder_tpu.tracing import InMemoryReporter, Tracer
+
+pytestmark = pytest.mark.obs
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+def _request(seed, t=9, horizon=6):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+    )
+
+
+def _mk_batcher(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    return ContinuousBatcher(
+        model, state.params, num_pages=16, page_size=8, slots=2,
+        max_prefix=16, max_pages_per_seq=4, **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+# -- ring buffer -------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_counts_drops():
+    fr = FlightRecorder(ring_size=8)
+    for i in range(100):
+        fr.instant("tick", i=i)
+    assert len(fr) == 8
+    assert fr.dropped == 92
+    # the ring keeps the TAIL of the run (the events a crash dump needs)
+    assert [e["args"]["i"] for e in fr.events()] == list(range(92, 100))
+    fr.clear()
+    assert len(fr) == 0 and fr.dropped == 0
+
+
+def test_ring_stays_bounded_under_a_long_serving_run(model_state):
+    """The acceptance memory bound: a run producing far more events
+    than ring_size holds exactly ring_size and counts the overflow."""
+    model, state = model_state
+    fr = FlightRecorder(ring_size=16)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+    for _ in range(4):
+        batcher.run([_request(i, horizon=7) for i in range(3)])
+    assert len(fr) == 16
+    assert fr.dropped > 0
+    assert len(fr.events()) == 16
+
+
+def test_recorder_rejects_degenerate_ring():
+    with pytest.raises(ValueError, match="ring_size"):
+        FlightRecorder(ring_size=0)
+
+
+# -- default OFF: byte-identical serving + exposition ------------------------
+
+
+def test_recorder_off_serving_and_exposition_byte_identical(model_state):
+    """The tentpole's parity pin: flight_recorder=None (the default)
+    must serve bit-identically and register not one extra series; and
+    turning the recorder ON must not change results either (it only
+    observes)."""
+    model, state = model_state
+    reqs = [_request(i, horizon=5) for i in range(3)]
+
+    plain_metrics = Metrics()
+    plain = _mk_batcher(model, state, metrics=plain_metrics)
+    base = plain.run([_request(i, horizon=5) for i in range(3)])
+
+    recorded_metrics = Metrics()
+    recorded = _mk_batcher(
+        model, state, metrics=recorded_metrics,
+        flight_recorder=FlightRecorder(ring_size=64),
+    )
+    got = recorded.run(reqs)
+
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # same series set: the recorder registers NOTHING on the registry
+    names = lambda m: {x.name for x in m.registry._metrics}  # noqa: E731
+    assert names(plain_metrics) == names(recorded_metrics)
+    # and the default Metrics set itself is untouched (the reference
+    # exposition parity pin lives in test_observability.py; this one
+    # pins that obs imports didn't widen it)
+    assert "beholder_obs" not in Metrics().registry.render()
+
+
+# -- timeline + trace linkage ------------------------------------------------
+
+
+def test_run_phases_and_claim_land_in_ring_with_trace_ids(model_state):
+    model, state = model_state
+    fr = FlightRecorder(ring_size=256)
+    tracer = Tracer("serving", reporter=InMemoryReporter())
+    batcher = _mk_batcher(model, state, tracer=tracer, flight_recorder=fr)
+    batcher.run([_request(i, horizon=5) for i in range(3)])
+    events = fr.events()
+    names = {e["name"] for e in events}
+    # claim is recorder-only (no new histogram phase label); the rest
+    # mirror the round spans
+    assert {"claim", "admit", "tick", "retire", "readback"} <= names
+    (root,) = [
+        s for s in tracer.reporter.spans if s.operation == "serving.run"
+    ]
+    trace_hex = f"{root.context.trace_id:032x}"
+    for e in events:
+        assert e["trace_id"] == trace_hex, e["name"]
+    # claim events carry the admission outcome
+    claims = [e for e in events if e["name"] == "claim"]
+    assert claims and all("claimed" in e["args"] for e in claims)
+
+
+def test_spec_run_records_accept_and_rollback_structure(model_state):
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    batcher = _mk_batcher(
+        model, state, flight_recorder=fr,
+        spec=SpecConfig(max_draft=3, accept_tol=1e-2),
+    )
+    batcher.run_spec([_request(i, horizon=8) for i in range(3)])
+    events = fr.events()
+    names = {e["name"] for e in events}
+    assert {"claim", "admit", "draft", "verify", "rollback", "retire"} <= names
+    accepts = [e for e in events if e["name"] == "spec.accept"]
+    assert accepts, "no spec accept markers recorded"
+    for e in accepts:
+        assert {"slot", "drafted", "accepted", "emitted"} <= set(e["args"])
+        assert e["args"]["emitted"] >= 1
+    # the scenario's relaxed tolerance guarantees some rejections →
+    # at least one page-freeing rollback marker
+    assert any(e["name"] == "spec.rollback" for e in events)
+
+
+def test_stall_marker_on_pressure_deferral(model_state):
+    """A request deferred for pool pressure leaves a stall instant in
+    the timeline — the deferral the histograms can't show."""
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=256)
+    # 8-page pool, 5-page requests: the second claim must defer until
+    # the first retires (slot free, pages not — a true pressure stall)
+    batcher = ContinuousBatcher(
+        model, state.params, num_pages=8, page_size=8, slots=2,
+        max_prefix=16, max_pages_per_seq=8, flight_recorder=fr,
+    )
+    batcher.run([_request(i, t=9, horizon=28) for i in range(2)])
+    stalls = [e for e in fr.events() if e["name"] == "stall"]
+    assert stalls
+    assert stalls[0]["args"]["reason"] == "pressure_deferral"
+    assert stalls[0]["args"]["need"] > stalls[0]["args"]["free"]
+
+
+# -- chrome trace export -----------------------------------------------------
+
+
+def test_chrome_trace_export_roundtrip(tmp_path, model_state):
+    """Acceptance: a real serving run exports to Chrome trace-event
+    JSON with per-round phase slices and spec accept/rollback markers,
+    via both the in-memory and the dump→CLI paths."""
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    tracer = Tracer("serving", reporter=InMemoryReporter())
+    batcher = _mk_batcher(
+        model, state, tracer=tracer, flight_recorder=fr,
+        spec=SpecConfig(max_draft=3, accept_tol=1e-2),
+    )
+    batcher.run_spec([_request(i, horizon=8) for i in range(3)])
+
+    out = trace_export.export(fr, str(tmp_path / "trace.json"))
+    trace = json.loads(open(out).read())
+    assert "traceEvents" in trace
+    slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {"admit", "verify", "rollback"} <= {e["name"] for e in slices}
+    for e in slices:
+        assert {"ts", "dur", "pid", "tid"} <= set(e)
+    assert any(
+        e["name"] == "spec.accept" and e.get("ph") == "i"
+        for e in trace["traceEvents"]
+    )
+    # every run-linked event sits on a NAMED per-trace track
+    tids = {e["tid"] for e in slices}
+    thread_names = {
+        e["tid"]: e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+    }
+    assert tids <= set(thread_names)
+
+    # dump → load_events → export: the offline path the service's
+    # shutdown dump feeds
+    dump = fr.dump(str(tmp_path / "events.jsonl"))
+    events = trace_export.load_events(dump)
+    assert len(events) == len(fr.events())
+    out2 = trace_export.export(dump, str(tmp_path / "trace2.json"))
+    assert json.loads(open(out2).read())["traceEvents"]
+
+
+def test_load_events_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text(
+        json.dumps({"name": "tick", "ph": "X", "ts_us": 1, "dur_us": 2})
+        + "\n{not json\n\n42\n"
+    )
+    events = trace_export.load_events(str(path))
+    assert [e["name"] for e in events] == ["tick"]
+
+
+# -- roofline attribution ----------------------------------------------------
+
+
+def test_attributor_measures_ceilings_and_tags_fractions():
+    att = RooflineAttributor(interval_s=600.0, matmul_n=64, copy_mb=0.5)
+    ceilings = att.ceilings()
+    assert ceilings["matmul_flops_per_s"] > 0
+    assert ceilings["memcpy_bytes_per_s"] > 0
+    assert att.ceilings() is ceilings  # cached within the interval
+    frac = att.observe("paged", flops=ceilings["matmul_flops_per_s"], dur_s=1.0)
+    assert frac == pytest.approx(1.0, rel=1e-3)
+    assert att.observe("paged", flops=1e6, dur_s=0.0) == 0.0
+    stats = att.family_stats()
+    assert stats["paged"]["events"] == 2
+
+
+def test_record_time_ceiling_frac_stamped_on_dispatches():
+    att = RooflineAttributor(interval_s=600.0, matmul_n=64, copy_mb=0.5)
+    att.ceilings()  # warm (bench does the same before serving)
+    fr = FlightRecorder(ring_size=16, attributor=att)
+    fr.record("tick", 0.0, 0.01, **fr.kernel_tags("paged", 1e6))
+    (event,) = fr.events()
+    assert event["args"]["family"] == "paged"
+    assert event["args"]["ceiling_frac"] > 0
+
+
+def test_observe_never_measures_inline_when_cold():
+    """The serving hot path must not stall on a cold attributor: the
+    first observation returns 0.0 immediately and kicks a BACKGROUND
+    measurement that eventually lands."""
+    import time as _time
+
+    att = RooflineAttributor(interval_s=600.0, matmul_n=64, copy_mb=0.5)
+    t0 = _time.perf_counter()
+    frac = att.observe("paged", flops=1e6, dur_s=0.01)
+    inline_s = _time.perf_counter() - t0
+    assert frac == 0.0
+    assert inline_s < 0.05  # no jit compile / timing probes inline
+    deadline = _time.time() + 30.0
+    while att.ceilings_nowait() is None and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert att.ceilings_nowait() is not None
+    assert att.observe("paged", flops=1e6, dur_s=0.01) > 0
+
+
+def test_attribution_summary_shape_and_readback_prorating():
+    ceilings = {"matmul_flops_per_s": 1e9}
+    events = [
+        # two dispatch families, 10 ms each of dispatch wall
+        {"name": "tick", "ph": "X", "ts_us": 0, "dur_us": 10_000,
+         "args": {"family": "paged", "flops": 3e6}},
+        {"name": "verify", "ph": "X", "ts_us": 0, "dur_us": 10_000,
+         "args": {"family": "verify", "flops": 1e6}},
+        # 20 ms of device wait, prorated 3:1 by flops
+        {"name": "readback", "ph": "X", "ts_us": 0, "dur_us": 20_000,
+         "args": {}},
+        {"name": "stall", "ph": "i", "ts_us": 0, "args": {}},
+    ]
+    s = attribution_summary(events, ceilings)
+    assert set(s) == {"phase_ms_pcts", "kernel_ceiling_fracs", "stall_pct"}
+    assert s["phase_ms_pcts"]["readback"] == 50.0
+    assert sum(s["phase_ms_pcts"].values()) == pytest.approx(100.0, abs=0.1)
+    # paged: 3e6 flops / (10ms + 15ms readback share) / 1e9 = 0.12
+    assert s["kernel_ceiling_fracs"]["paged"] == pytest.approx(0.12, abs=1e-3)
+    # verify: 1e6 / (10ms + 5ms) / 1e9 = 0.0667
+    assert s["kernel_ceiling_fracs"]["verify"] == pytest.approx(
+        0.0667, abs=1e-3
+    )
+    assert s["stall_pct"] == 50.0
+
+
+def test_attribution_summary_counts_nested_device_waits_as_stall():
+    """The spec loop has no top-level readback round — its waits are
+    nested device_wait slices inside admit/verify. They must feed
+    stall_pct WITHOUT double-counting the wall (excluded from
+    phase_ms_pcts and the total)."""
+    events = [
+        {"name": "verify", "ph": "X", "ts_us": 0, "dur_us": 10_000,
+         "args": {}},
+        # nested inside the verify round above
+        {"name": "device_wait", "ph": "X", "ts_us": 2_000, "dur_us": 6_000,
+         "args": {}},
+        {"name": "draft", "ph": "X", "ts_us": 0, "dur_us": 10_000,
+         "args": {}},
+    ]
+    s = attribution_summary(events)
+    assert "device_wait" not in s["phase_ms_pcts"]
+    assert s["phase_ms_pcts"]["verify"] == 50.0  # total stays 20 ms
+    assert s["stall_pct"] == 30.0  # 6 ms wait / 20 ms wall
+
+
+def test_spec_run_records_nested_device_waits(model_state):
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    batcher = _mk_batcher(
+        model, state, flight_recorder=fr,
+        spec=SpecConfig(max_draft=3, accept_tol=1e-2),
+    )
+    batcher.run_spec([_request(i, horizon=8) for i in range(2)])
+    waits = [e for e in fr.events() if e["name"] == "device_wait"]
+    assert waits and all(e["dur_us"] >= 0 for e in waits)
+    s = attribution_summary(fr.events())
+    assert s["stall_pct"] > 0  # the committed-artifact gate is live
+
+
+def test_attribution_summary_empty_events():
+    s = attribution_summary([])
+    assert s == {
+        "phase_ms_pcts": {},
+        "kernel_ceiling_fracs": {},
+        "stall_pct": 0.0,
+    }
+
+
+def test_model_flops_per_token_scales_with_context(model_state):
+    model, _ = model_state
+    assert model_flops_per_token(model, 512) > model_flops_per_token(model, 8)
+    assert model_flops_per_token(model, 0) > 0  # ctx floor, never zero
+
+
+# -- config wiring -----------------------------------------------------------
+
+
+def _config(**flight):
+    from beholder_tpu.config import ConfigNode
+
+    return ConfigNode(
+        {"instance": {"observability": {"flight_recorder": flight}}}
+    )
+
+
+def test_flight_recorder_from_config_disabled_is_none():
+    from beholder_tpu.config import ConfigNode
+
+    assert flight_recorder_from_config(ConfigNode({})) is None
+    assert flight_recorder_from_config(_config(enabled=False)) is None
+
+
+def test_flight_recorder_from_config_knobs():
+    fr = flight_recorder_from_config(
+        _config(
+            enabled=True, ring_size=128, export_path="/tmp/x.jsonl",
+            ceiling_interval_s=60,
+        )
+    )
+    assert fr.ring_size == 128
+    assert fr.export_path == "/tmp/x.jsonl"
+    assert fr.attributor is not None
+    assert fr.attributor.interval_s == 60.0
+    # <= 0 keeps the timeline but disables attribution
+    assert (
+        flight_recorder_from_config(
+            _config(enabled=True, ceiling_interval_s=0)
+        ).attributor
+        is None
+    )
+
+
+def test_service_shutdown_flushes_spans_and_dumps_ring(tmp_path):
+    """Satellite: SIGTERM/close() must not drop the observability tail —
+    open spans report (tagged), the flight-recorder ring lands on disk."""
+    from beholder_tpu import proto
+    from beholder_tpu.config import ConfigNode
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import BeholderService
+    from beholder_tpu.storage import MemoryStorage
+
+    span_path = tmp_path / "spans.jsonl"
+    ring_path = tmp_path / "flight.jsonl"
+    config = ConfigNode(
+        {
+            "keys": {"trello": {"key": "K", "token": "T"}},
+            "instance": {
+                "flow_ids": {},
+                "tracing": {"enabled": True, "jsonl_path": str(span_path)},
+                "observability": {
+                    "flight_recorder": {
+                        "enabled": True,
+                        "ring_size": 32,
+                        "export_path": str(ring_path),
+                        "ceiling_interval_s": 0,
+                    }
+                },
+            },
+        }
+    )
+    db = MemoryStorage()
+    db.add_media(
+        proto.Media(
+            id="m1", name="M", creator=proto.CreatorType.TRELLO,
+            creatorId="c1", metadataId="1",
+        )
+    )
+    service = BeholderService(config, InMemoryBroker(), db)
+    service.start()
+    assert service.flight_recorder is not None
+    service.flight_recorder.instant("boot", note="pre-shutdown event")
+    open_span = service.tracer.start_span("interrupted.work")
+    assert not open_span.finished
+    service.close()
+    assert open_span.finished
+    reported = [
+        json.loads(line) for line in span_path.read_text().splitlines()
+    ]
+    flushed = [
+        s for s in reported if s["operationName"] == "interrupted.work"
+    ]
+    assert flushed and flushed[0]["tags"]["flushed_at_shutdown"] is True
+    dumped = trace_export.load_events(str(ring_path))
+    assert [e["name"] for e in dumped] == ["boot"]
+
+
+# -- artifact schema v5 ------------------------------------------------------
+
+
+def test_artifact_v5_carries_and_validates_attribution():
+    rec = artifact.ArtifactRecorder("t")
+    doc = rec.to_dict()
+    assert doc["schema_version"] == 5
+    artifact.validate(doc)  # empty attribution block is valid
+    rec.record_attribution(
+        {
+            "phase_ms_pcts": {"tick": 60.0, "readback": 40.0},
+            "kernel_ceiling_fracs": {"paged": 0.4},
+            "stall_pct": 40.0,
+            "extra_key": "dropped",  # only the schema keys are adopted
+        }
+    )
+    doc = rec.to_dict()
+    assert doc["attribution"]["phase_ms_pcts"]["tick"] == 60.0
+    assert "extra_key" not in doc["attribution"]
+    artifact.validate(doc)
+
+    with pytest.raises(ValueError, match="missing 'stall_pct'"):
+        rec.record_attribution({"phase_ms_pcts": {}, "kernel_ceiling_fracs": {}})
+
+    bad = rec.to_dict()
+    del bad["attribution"]
+    with pytest.raises(ValueError, match="attribution must be a dict"):
+        artifact.validate(bad)
+    bad = rec.to_dict()
+    bad["attribution"]["phase_ms_pcts"] = {"tick": "sixty"}
+    with pytest.raises(ValueError, match="phase_ms_pcts"):
+        artifact.validate(bad)
+
+
+def test_record_attribution_module_plumbing():
+    rec = artifact.ArtifactRecorder("t")
+    artifact.set_current(rec)
+    try:
+        artifact.record_attribution(
+            {
+                "phase_ms_pcts": {"wave": 100.0},
+                "kernel_ceiling_fracs": {},
+                "stall_pct": 0.0,
+            }
+        )
+    finally:
+        artifact.set_current(None)
+    assert rec.attribution["phase_ms_pcts"] == {"wave": 100.0}
+    artifact.record_attribution({"phase_ms_pcts": {}})  # no-op, no recorder
+
+
+# -- perf gate ---------------------------------------------------------------
+
+
+def _artifact_doc(
+    mean_accept_len=1.5,
+    warm_cold=0.2,
+    native=1100.0,
+    python=1000.0,
+    phases=None,
+    stall=10.0,
+    msgs=100_000.0,
+    fracs=None,
+):
+    rec = artifact.ArtifactRecorder("bench_e2e")
+    rec.section("service", {"value": msgs})
+    rec.section("wire_native", {"rate": native})
+    rec.section("wire_python", {"rate": python})
+    rec.section("prefix_cache", {"value": warm_cold})
+    rec.record_attribution(
+        {
+            "phase_ms_pcts": phases
+            if phases is not None
+            else {"admit": 50.0, "verify": 40.0, "claim": 1.0},
+            "kernel_ceiling_fracs": (
+                fracs if fracs is not None else {"flash": 0.4}
+            ),
+            "stall_pct": stall,
+        }
+    )
+    doc = rec.to_dict()
+    doc["spec"]["mean_accept_len"] = mean_accept_len
+    return doc
+
+
+def test_perf_gate_passes_on_identical_artifacts():
+    doc = _artifact_doc()
+    verdict = perf_gate.run_gate(doc, doc)
+    assert verdict["verdict"] == "pass"
+    gated = {c["metric"] for c in verdict["checks"]}
+    assert {
+        "native_speedup", "warm_cold_prefill_ratio", "mean_accept_len",
+        "phase_pct:admit", "phase_pct:verify", "stall_pct",
+    } <= gated
+    # sub-floor phases are not gated (structure noise)
+    assert "phase_pct:claim" not in gated
+    # accel missing on both sides: skipped, not failed
+    assert {"metric": "mfu_vs_measured_matmul", "reason": "missing in baseline"} in (
+        verdict["skipped"]
+    )
+
+
+def test_perf_gate_fails_on_degraded_ratios():
+    base = _artifact_doc()
+    for degraded, metric in [
+        (_artifact_doc(mean_accept_len=1.0), "mean_accept_len"),
+        (_artifact_doc(warm_cold=0.8), "warm_cold_prefill_ratio"),
+        (_artifact_doc(native=600.0), "native_speedup"),
+        (
+            _artifact_doc(phases={"admit": 85.0, "verify": 5.0, "claim": 1.0}),
+            "phase_pct:admit",
+        ),
+        (_artifact_doc(stall=60.0), "stall_pct"),
+        (_artifact_doc(fracs={"flash": 0.15}), "kernel_ceiling_frac:flash"),
+    ]:
+        verdict = perf_gate.run_gate(base, degraded)
+        assert verdict["verdict"] == "fail", metric
+        assert metric in verdict["failed"], metric
+
+
+def test_perf_gate_catches_small_or_new_phase_eating_the_round():
+    """The union gate: a phase below the floor in the baseline (or
+    absent from it entirely — pct 0 by definition) still fails when it
+    grows to dominate the step."""
+    base = _artifact_doc(phases={"admit": 55.0, "verify": 43.0, "draft": 2.0})
+    grown = _artifact_doc(
+        phases={"admit": 40.0, "verify": 28.0, "draft": 32.0}
+    )
+    verdict = perf_gate.run_gate(base, grown)
+    assert verdict["verdict"] == "fail"
+    assert "phase_pct:draft" in verdict["failed"]
+    new_phase = _artifact_doc(
+        phases={"admit": 45.0, "verify": 30.0, "gc": 25.0}
+    )
+    assert "phase_pct:gc" in perf_gate.run_gate(base, new_phase)["failed"]
+
+
+def test_perf_gate_never_gates_absolutes():
+    """A 10x msg/s collapse with stable ratios passes — absolute
+    figures are host noise by charter (BENCH_NOTES.md) and appear only
+    in the reported block."""
+    base = _artifact_doc(msgs=100_000.0, native=1100.0, python=1000.0)
+    cur = _artifact_doc(msgs=10_000.0, native=110.0, python=100.0)
+    verdict = perf_gate.run_gate(base, cur)
+    assert verdict["verdict"] == "pass"
+    reported = verdict["reported_not_gated"]["telemetry_msgs_per_sec"]
+    assert reported == {"baseline": 100_000.0, "current": 10_000.0}
+
+
+def test_perf_gate_improvements_pass():
+    verdict = perf_gate.run_gate(
+        _artifact_doc(), _artifact_doc(mean_accept_len=3.0, warm_cold=0.05)
+    )
+    assert verdict["verdict"] == "pass"
+
+
+def test_perf_gate_cli_on_committed_artifacts(tmp_path, capsys):
+    """Acceptance: the gate passes on the committed v5 artifacts and
+    fails (exit 1 + machine-readable verdict) on a synthetically
+    degraded ratio."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    committed = os.path.join(repo, "artifacts", "bench_e2e.json")
+    assert perf_gate.main(["--baseline", committed, "--current", committed]) == 0
+    capsys.readouterr()
+
+    degraded = json.load(open(committed))
+    degraded["spec"]["mean_accept_len"] = 1.0  # no speculation win
+    bad = tmp_path / "degraded.json"
+    bad.write_text(json.dumps(degraded))
+    out = tmp_path / "verdict.json"
+    rc = perf_gate.main(
+        ["--baseline", committed, "--current", str(bad), "--out", str(out)]
+    )
+    assert rc == 1
+    verdict = json.loads(out.read_text())
+    assert verdict["verdict"] == "fail"
+    assert "mean_accept_len" in verdict["failed"]
+    assert verdict["schema"] == "beholder-perf-gate"
+
+
+def test_perf_gate_cli_rejects_pre_v5_current(tmp_path):
+    old = artifact.ArtifactRecorder("bench_e2e").to_dict()
+    old["schema_version"] = 4
+    del old["attribution"]
+    path = tmp_path / "old.json"
+    path.write_text(json.dumps(old))
+    with pytest.raises(SystemExit, match="v5 attribution"):
+        perf_gate.main(["--baseline", str(path), "--current", str(path)])

@@ -31,6 +31,8 @@ from beholder_tpu.service import PROGRESS_TOPIC, STATUS_TOPIC, BeholderService
 from beholder_tpu.storage import MemoryStorage
 from beholder_tpu.tracing import InMemoryReporter, Tracer, current_trace_id
 
+pytestmark = pytest.mark.obs
+
 
 # -- metric primitives -------------------------------------------------------
 
@@ -195,6 +197,69 @@ def test_unsampled_span_suppresses_nested_fallback_spans():
     assert tracer.reporter.spans == []
 
 
+def test_tracer_flush_reports_open_spans_once():
+    """Shutdown flush: spans still open report exactly once, tagged, and
+    already-finished spans are untouched (finish stays idempotent)."""
+    tracer = Tracer("svc", reporter=InMemoryReporter())
+    done = tracer.start_span("done")
+    done.finish()
+    left_open = tracer.start_span("left.open")
+    nested = tracer.start_span("nested", child_of=left_open)
+    assert tracer.flush() == 2
+    assert left_open.finished and nested.finished
+    assert left_open.tags["flushed_at_shutdown"] is True
+    assert "flushed_at_shutdown" not in done.tags
+    ops = [s.operation for s in tracer.reporter.spans]
+    assert sorted(ops) == ["done", "left.open", "nested"]
+    assert tracer.flush() == 0  # idempotent: nothing left to flush
+
+
+def test_flush_observation_log_closes_cached_handle(obs_log):
+    """Shutdown flush for the raw-observation jsonl: the cached append
+    handle closes (the tail is on disk) and the next observation
+    transparently re-opens it."""
+    from beholder_tpu import metrics as metrics_mod
+
+    h = Histogram("flush_seconds", "x")
+    h.observe(0.1)
+    assert metrics_mod._obs_file is not None
+    metrics_mod.flush_observation_log()
+    assert metrics_mod._obs_file is None
+    h.observe(0.2)  # re-opens
+    values = [
+        json.loads(line)["value"] for line in obs_log.read_text().splitlines()
+    ]
+    assert values == [0.1, 0.2]
+
+
+def test_histogram_exemplars_link_buckets_to_traces():
+    """Satellite: the reverse direction of the observation log — each
+    bucket remembers its latest traced observation, so a slow bucket is
+    one lookup from its trace timeline. Untraced observations leave no
+    exemplar; the classic exposition is unchanged."""
+    tracer = Tracer("svc", reporter=InMemoryReporter())
+    h = Histogram("ex_seconds", "x", labelnames=["op"], buckets=[0.1, 1.0])
+    h.observe(0.05, op="a")  # outside any span: no exemplar
+    with tracer.start_span("slow.call") as span:
+        h.observe(0.5, op="a")
+    with tracer.start_span("slower.call") as span2:
+        h.observe(0.7, op="a")  # same bucket: latest wins
+        h.observe(5.0, op="a")  # overflow bucket
+    ex = h.exemplars(op="a")
+    assert set(ex) == {"1", "+Inf"}
+    assert ex["1"]["trace_id"] == f"{span2.context.trace_id:032x}"
+    assert ex["1"]["value"] == 0.7
+    assert ex["+Inf"]["trace_id"] == f"{span2.context.trace_id:032x}"
+    assert f"{span.context.trace_id:032x}" not in {
+        e["trace_id"] for e in ex.values()
+    }
+    # explicit id (callers whose span closed before the observation)
+    h.observe(0.02, exemplar_trace_id="feed" * 8, op="b")
+    assert h.exemplars(op="b")["0.1"]["trace_id"] == "feed" * 8
+    # exemplars never render: classic exposition parity
+    assert "feed" not in h.render() and "trace" not in h.render()
+
+
 # -- serving scheduler -------------------------------------------------------
 
 
@@ -295,6 +360,60 @@ def test_serving_run_span_parents_round_spans():
     for s in spans:
         if s is not root:
             assert s.context.parent_id == root.context.span_id
+
+
+def test_spec_run_span_parents_rounds_across_verify_rounds():
+    """Satellite: one serving.run_spec root; every admit/draft/verify/
+    rollback/retire round — across MULTIPLE verify rounds — is its
+    direct child in the same trace (round spans must not accidentally
+    parent to the previous round via the active-span fallback)."""
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = _mk_model_state()
+    tracer = Tracer("serving", reporter=InMemoryReporter())
+    batcher = _mk_batcher(
+        model, state, tracer=tracer,
+        spec=SpecConfig(max_draft=2, accept_tol=1e-2),
+    )
+    batcher.run_spec([_request(i, horizon=8) for i in range(3)])
+    spans = tracer.reporter.spans
+    (root,) = [s for s in spans if s.operation == "serving.run_spec"]
+    rounds = [s for s in spans if s is not root]
+    assert {s.operation for s in rounds} >= {
+        "serving.admit", "serving.draft", "serving.verify",
+        "serving.rollback", "serving.retire",
+    }
+    # the decode-heavy horizon guarantees several verify rounds
+    verifies = [s for s in rounds if s.operation == "serving.verify"]
+    assert len(verifies) >= 2
+    for s in rounds:
+        assert s.context.trace_id == root.context.trace_id, s.operation
+        assert s.context.parent_id == root.context.span_id, s.operation
+    assert spans[-1] is root  # children report before the run span
+
+
+def test_serving_round_histogram_carries_exemplar_trace_ids():
+    """Satellite: round/run histogram observations carry the run span's
+    trace id as a bucket exemplar, even though the span closes before
+    the observation lands — the reverse link from a slow /metrics
+    bucket to its flight-recorder/span timeline."""
+    model, state = _mk_model_state()
+    metrics = Metrics()
+    tracer = Tracer("serving", reporter=InMemoryReporter())
+    batcher = _mk_batcher(model, state, metrics=metrics, tracer=tracer)
+    batcher.run([_request(3, horizon=5)])
+    (root,) = [
+        s for s in tracer.reporter.spans if s.operation == "serving.run"
+    ]
+    trace_hex = f"{root.context.trace_id:032x}"
+    rounds = metrics.registry.find("beholder_serving_round_duration_seconds")
+    for phase in ("admit", "tick", "retire", "readback"):
+        ex = rounds.exemplars(phase=phase)
+        assert ex, phase
+        assert {e["trace_id"] for e in ex.values()} == {trace_hex}, phase
+    runs = metrics.registry.find("beholder_serving_run_duration_seconds")
+    (run_ex,) = runs.exemplars(mode="run").values()
+    assert run_ex["trace_id"] == trace_hex
 
 
 def test_serving_device_results_counts_dispatched_not_served():
