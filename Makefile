@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster perf-gate lint clean
 
 all: proto native
 
@@ -53,6 +53,17 @@ bench-cache:
 bench-spec:
 	python bench.py --spec-only
 
+# the cluster scenario alone: a mixed prefill/decode trace on a
+# 2-shard cluster, colocated vs disaggregated, on a FORCED 8-device
+# host-platform mesh (the MULTICHIP harness trick) so the shards and
+# the prefill worker land on distinct virtual devices and the
+# page-granular KV handoff is a real cross-device copy (writes
+# artifacts/bench_cluster.json; the full `make bench` run carries the
+# same scenario inside bench_e2e.json)
+bench-cluster:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+		python bench.py --cluster-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -65,6 +76,8 @@ perf-gate:
 		--baseline artifacts/bench_e2e.json --current artifacts/bench_e2e.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_spec.json --current artifacts/bench_spec.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_cluster.json --current artifacts/bench_cluster.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -42,7 +42,10 @@ Schema (``validate`` is the authoritative checker)::
                "mean_accept_len": 0.0},  # v4: speculative decoding
       "attribution": {"phase_ms_pcts": {...},
                       "kernel_ceiling_fracs": {...},
-                      "stall_pct": 0.0}  # v5: flight-recorder roofline
+                      "stall_pct": 0.0},  # v5: flight-recorder roofline
+      "cluster": {"shards": 0.0, "transfers": 0.0,
+                  "transferred_pages": 0.0, "routed": 0.0,
+                  "sheds_by_shard": {}}  # v6: cluster serving
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -77,6 +80,15 @@ achieved fraction of the matmul ceiling MEASURED ON THE SAME HOST
 ``beholder_tpu/tools/perf_gate.py`` gates on — absolute figures stay
 in the artifact as evidence but are never gated (BENCH_NOTES.md: ±30%
 host swings). v1-v4 artifacts remain valid.
+
+Schema v6 (the cluster-serving PR): the run's cluster counters ride
+along (:meth:`ArtifactRecorder.record_cluster`) — decode shards, KV
+handoffs and pages moved through the prefill->decode transfer path,
+routing decisions, and sheds attributed per shard queue. A headline
+figure produced on a sharded mesh now says how many chips and how much
+page traffic backed it; the ``make bench-cluster`` acceptance gate
+asserts the committed artifact records NON-ZERO page transfers. v1-v5
+artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -88,7 +100,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -137,6 +149,21 @@ SPEC_COUNTERS = {
 #: per verify slot-step)
 SPEC_EMITTED_COUNTER = "beholder_spec_emitted_tokens_total"
 SPEC_STEPS_COUNTER = "beholder_spec_verify_steps_total"
+
+#: v6: artifact key -> the cluster counter summed into it
+CLUSTER_COUNTERS = {
+    "transfers": "beholder_cluster_transfers_total",
+    "transferred_pages": "beholder_cluster_transferred_pages_total",
+    "routed": "beholder_cluster_routes_total",
+}
+
+#: v6: the snapshot gauge — decode shards in the cluster when the
+#: registry was recorded (latest snapshot wins, not a sum)
+CLUSTER_SHARDS_GAUGE = "beholder_cluster_shards"
+
+#: v6: per-shard shed attribution (the labelled intake twin); totals
+#: fold by the ``queue`` label into ``sheds_by_shard``
+CLUSTER_SHED_COUNTER = "beholder_intake_shed_total"
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
@@ -210,6 +237,11 @@ class ArtifactRecorder:
         self._spec_emitted = 0.0
         self._spec_steps = 0.0
         self.attribution: dict[str, Any] = copy.deepcopy(EMPTY_ATTRIBUTION)
+        self.cluster: dict[str, Any] = {
+            key: 0.0 for key in CLUSTER_COUNTERS
+        }
+        self.cluster["shards"] = 0.0
+        self.cluster["sheds_by_shard"] = {}
 
     def section(
         self,
@@ -307,6 +339,34 @@ class ArtifactRecorder:
             if counter is not None:
                 setattr(self, attr, getattr(self, attr) + float(counter.total()))
 
+    def record_cluster(self, registry) -> None:
+        """Accumulate one registry's cluster counters (KV handoffs,
+        transferred pages, routing decisions; ``shards`` takes the
+        registry's current gauge value — a snapshot, not a sum;
+        ``sheds_by_shard`` folds the labelled intake shed counter by
+        its ``queue`` label). Same accumulate-across-registries
+        contract as :meth:`record_reliability`."""
+        find = getattr(registry, "find", None)
+        if find is None:  # a Metrics wrapper
+            registry = getattr(registry, "registry", None)
+            find = getattr(registry, "find", None)
+            if find is None:
+                return
+        for key, name in CLUSTER_COUNTERS.items():
+            counter = find(name)
+            if counter is not None:
+                self.cluster[key] += float(counter.total())
+        gauge = find(CLUSTER_SHARDS_GAUGE)
+        if gauge is not None:
+            self.cluster["shards"] = float(gauge.value())
+        sheds = find(CLUSTER_SHED_COUNTER)
+        if sheds is not None and "queue" in sheds.labelnames:
+            qi = sheds.labelnames.index("queue")
+            by_shard = self.cluster["sheds_by_shard"]
+            for key, value in sheds.items():
+                queue = key[qi]
+                by_shard[queue] = by_shard.get(queue, 0.0) + float(value)
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -349,6 +409,7 @@ class ArtifactRecorder:
                 ),
             },
             "attribution": copy.deepcopy(self.attribution),
+            "cluster": copy.deepcopy(self.cluster),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -415,6 +476,14 @@ def record_attribution(summary: dict) -> None:
     contract as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_attribution(summary)
+
+
+def record_cluster(registry) -> None:
+    """Accumulate a registry's cluster counters into the active
+    recorder's v6 ``cluster`` block; no-op without one (same contract
+    as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_cluster(registry)
 
 
 # -- validation ---------------------------------------------------------------
@@ -510,6 +579,26 @@ def validate(obj: Any) -> None:
                 problems.append(
                     "attribution.stall_pct must be a number, "
                     f"got {attribution.get('stall_pct')!r}"
+                )
+    if isinstance(version, int) and version >= 6:
+        # v6: cluster-serving counters are part of the evidence
+        cluster = obj.get("cluster")
+        if not isinstance(cluster, dict):
+            problems.append("cluster must be a dict (schema v6+)")
+        else:
+            for key in (*CLUSTER_COUNTERS, "shards"):
+                if not isinstance(cluster.get(key), (int, float)):
+                    problems.append(
+                        f"cluster.{key} must be a number, "
+                        f"got {cluster.get(key)!r}"
+                    )
+            sheds = cluster.get("sheds_by_shard")
+            if not isinstance(sheds, dict) or not all(
+                isinstance(v, (int, float)) for v in sheds.values()
+            ):
+                problems.append(
+                    "cluster.sheds_by_shard must be a dict of numbers, "
+                    f"got {sheds!r}"
                 )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

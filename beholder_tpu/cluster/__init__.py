@@ -1,0 +1,135 @@
+"""Disaggregated multi-chip serving: shard the paged KV pool across
+the mesh and split prefill from decode.
+
+EXTENSION BEYOND THE REFERENCE (which has no inference of any kind —
+SURVEY.md §0). The single-device serving engine caps concurrent-user
+capacity at one chip's HBM: one paged pool, one
+:class:`~beholder_tpu.models.serving.ContinuousBatcher`. This
+subsystem turns that engine into an N-worker cluster in the spirit of
+GPUOS's transparent scheduling primitives (PAPERS.md) — same
+submit/run API, same bitwise outputs, N× the pool:
+
+- **Sharded KV pool** (:mod:`.pool`): each decode shard owns its own
+  paged pool + page table on its own mesh device, with per-shard free
+  lists and refcounts (a shard's pool IS a
+  :class:`~beholder_tpu.models.serving.PagedKVState`, so every
+  allocator invariant already pinned — refcounted prefix sharing,
+  prefix-cache pins, spec rollback — holds PER SHARD for free). Total
+  KV capacity (= concurrent users) scales with shard count.
+- **Prefill/decode disaggregation** (:mod:`.transfer`): dedicated
+  prefill workers run the prefill forward OFF-POOL
+  (:func:`~beholder_tpu.models.serving.kv_prefill_chunks`) and hand
+  the KV to the owning decode shard page-granularly
+  (:func:`~beholder_tpu.models.serving.paged_adopt_chunks`), so a
+  long prefill occupies a prefill worker's FLOPs, not the decode
+  shard's tick cadence. The destination pool ends up bitwise what a
+  colocated prefill would have written.
+- **Cluster scheduler** (:mod:`.router`): the batcher promoted to a
+  cluster-level admission router — route by pool pressure per shard
+  (or round-robin), per-shard bounded intakes with labelled shed
+  attribution, rebalance queued work across shards at drain time,
+  and a per-shard serving loop that claims via the same invariants as
+  ``ContinuousBatcher._claim_admissions``.
+
+**Exactness.** Under exact greedy the cluster emits token streams
+bitwise-identical to the single-device engine on the same request
+stream: a slot's decode reads only its own pages, the handoff writes
+pool content byte-for-byte (same ``_write_chunks`` cast path), and
+the carry seeds apply the same casts — routing and disaggregation
+change WHERE work runs, never what it computes (pinned by
+``tests/test_cluster.py``).
+
+Everything is opt-in: the service parses ``instance.cluster.*`` into
+a :class:`ClusterConfig` (None when disabled — the default, under
+which serving behavior and the /metrics exposition stay
+byte-identical); whatever embeds the serving layer builds a
+:class:`~beholder_tpu.cluster.router.ClusterScheduler` from it. This
+module stays import-light (no jax) — the device half lives in
+:mod:`.pool` / :mod:`.transfer` / :mod:`.router` and loads on first
+use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: routing policies
+ROUTE_PRESSURE = "pressure"
+ROUTE_ROUND_ROBIN = "round_robin"
+
+
+@dataclass
+class ClusterConfig:
+    """Cluster-serving knobs (``instance.cluster.*``).
+
+    ``n_prefill_workers == 0`` is the COLOCATED cluster: requests
+    route to decode shards that prefill and decode on their own pool
+    (capacity scaling without disaggregation). ``>= 1`` arms the
+    disaggregated path: prefill runs on dedicated workers and the KV
+    hands off page-granularly to the owning decode shard."""
+
+    n_decode_workers: int = 2
+    n_prefill_workers: int = 0
+    route_policy: str = ROUTE_PRESSURE   # pressure | round_robin
+    #: per-shard intake bounds (the admission-control front door; the
+    #: page-cost bound defaults to the shard's own pool size so a
+    #: shard sheds when its queued worst-case pages exceed what it
+    #: can ever hold)
+    max_pending_per_shard: int = 16
+    max_pending_pages_per_shard: int | None = None
+
+    def __post_init__(self):
+        if self.n_decode_workers < 1:
+            raise ValueError(
+                f"n_decode_workers must be >= 1, got {self.n_decode_workers}"
+            )
+        if self.n_prefill_workers < 0:
+            raise ValueError(
+                f"n_prefill_workers must be >= 0, "
+                f"got {self.n_prefill_workers}"
+            )
+        if self.route_policy not in (ROUTE_PRESSURE, ROUTE_ROUND_ROBIN):
+            raise ValueError(
+                f"route_policy must be {ROUTE_PRESSURE!r}|"
+                f"{ROUTE_ROUND_ROBIN!r}, got {self.route_policy!r}"
+            )
+        if self.max_pending_per_shard < 1:
+            raise ValueError(
+                f"max_pending_per_shard must be >= 1, "
+                f"got {self.max_pending_per_shard}"
+            )
+
+
+def cluster_from_config(config) -> ClusterConfig | None:
+    """Parse ``instance.cluster.*`` into a :class:`ClusterConfig`;
+    None unless ``instance.cluster.enabled`` — the same off-by-default
+    contract as the cache/spec/flight-recorder subsystems (disabled
+    means byte-identical behavior and exposition)."""
+    if not bool(config.get("instance.cluster.enabled")):
+        return None
+    max_pages = config.get("instance.cluster.max_pending_pages_per_shard")
+    return ClusterConfig(
+        n_decode_workers=int(
+            config.get("instance.cluster.n_decode_workers", 2)
+        ),
+        n_prefill_workers=int(
+            config.get("instance.cluster.n_prefill_workers", 0)
+        ),
+        route_policy=str(
+            config.get("instance.cluster.route_policy", ROUTE_PRESSURE)
+        ),
+        max_pending_per_shard=int(
+            config.get("instance.cluster.max_pending_per_shard", 16)
+        ),
+        max_pending_pages_per_shard=(
+            int(max_pages) if max_pages is not None else None
+        ),
+    )
+
+
+__all__ = [
+    "ClusterConfig",
+    "ROUTE_PRESSURE",
+    "ROUTE_ROUND_ROBIN",
+    "cluster_from_config",
+]

@@ -1,0 +1,104 @@
+"""The cluster subsystem's metric catalog.
+
+Extension surface like ``cache/instruments.py`` / ``spec/
+instruments.py``: nothing is registered unless a cluster scheduler is
+handed a registry, so the reference exposition stays byte-identical by
+default (pinned by ``tests/test_cluster.py``). Every series uses
+:func:`~beholder_tpu.metrics.get_or_create`, so a replacement
+scheduler re-attaches instead of tripping the duplicate guard.
+
+Catalog (all appear only when a cluster scheduler gets a registry):
+
+- ``beholder_cluster_shards`` — gauge: decode shards in this cluster
+- ``beholder_cluster_pool_pages_free{shard}`` — gauge: each shard's
+  free KV pages by the router's host arithmetic (the per-shard twin of
+  the unlabelled ``beholder_serving_pool_pages_free``, which N shard
+  batchers would otherwise overwrite)
+- ``beholder_cluster_pool_pages_committed{shard}`` — gauge: worst-case
+  pages committed to each shard's queued + in-flight requests
+- ``beholder_cluster_transfers_total`` — counter: prefill->decode KV
+  handoffs completed
+- ``beholder_cluster_transferred_pages_total`` — counter: live KV
+  pages moved by those handoffs
+- ``beholder_cluster_transferred_bytes_total`` — counter: live KV
+  bytes moved (page bytes x layers x k+v, at the transfer dtype)
+- ``beholder_cluster_routes_total{reason}`` — counter: routing
+  decisions by reason (``pressure`` / ``round_robin`` / ``only_shard``
+  / ``rebalance``)
+- ``beholder_cluster_requests_total{shard}`` — counter: requests fully
+  served, attributed to the shard that decoded them
+
+Shed attribution lives on the intake side:
+``beholder_intake_shed_total{queue, reason}`` (see
+:class:`~beholder_tpu.reliability.shed.IntakeQueue` — the router names
+each shard's queue uniquely, so sheds chart per shard).
+"""
+
+from __future__ import annotations
+
+from beholder_tpu.metrics import get_or_create
+
+
+class ClusterMetrics:
+    """The series above, find-or-registered on a shared registry (a
+    :class:`~beholder_tpu.metrics.Registry`, or a
+    :class:`~beholder_tpu.metrics.Metrics` whose registry is used)."""
+
+    def __init__(self, registry):
+        registry = getattr(registry, "registry", registry)
+        self.registry = registry
+        self.shards = get_or_create(
+            registry, "gauge",
+            "beholder_cluster_shards",
+            "Decode shards (per-shard paged KV pools) in this cluster",
+        )
+        self.pool_pages_free = get_or_create(
+            registry, "gauge",
+            "beholder_cluster_pool_pages_free",
+            "Free KV pages per decode shard (router host arithmetic)",
+            labelnames=["shard"],
+        )
+        self.pool_pages_committed = get_or_create(
+            registry, "gauge",
+            "beholder_cluster_pool_pages_committed",
+            "Worst-case KV pages committed to queued + in-flight "
+            "requests per decode shard",
+            labelnames=["shard"],
+        )
+        self.transfers_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_transfers_total",
+            "Prefill->decode page-granular KV handoffs completed",
+        )
+        self.transferred_pages_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_transferred_pages_total",
+            "Live KV pages moved by prefill->decode handoffs",
+        )
+        self.transferred_bytes_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_transferred_bytes_total",
+            "Live KV bytes moved by prefill->decode handoffs",
+        )
+        self.routes_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_routes_total",
+            "Cluster routing decisions by reason",
+            labelnames=["reason"],
+        )
+        self.requests_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_requests_total",
+            "Requests fully served, by the decode shard that served them",
+            labelnames=["shard"],
+        )
+
+    def observe_transfer(self, pages: int, nbytes: int) -> None:
+        """Record one completed prefill->decode handoff."""
+        self.transfers_total.inc()
+        self.transferred_pages_total.inc(pages)
+        self.transferred_bytes_total.inc(nbytes)
+
+    def set_shard_pool(self, shard: str, free: int, committed: int) -> None:
+        self.pool_pages_free.set(free, shard=shard)
+        self.pool_pages_committed.set(committed, shard=shard)

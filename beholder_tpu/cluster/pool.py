@@ -1,0 +1,107 @@
+"""The sharded KV pool: per-shard page accounting + mesh placement.
+
+A shard's device-side pool IS a
+:class:`~beholder_tpu.models.serving.PagedKVState` — per-shard free
+stack, per-shard refcounts, every allocator invariant already pinned
+by the serving tests holds per shard by construction. What this
+module adds is the HOST half the cluster router schedules on:
+
+- :class:`ShardPool` — one shard's worst-case page arithmetic
+  (``committed`` mirrors what the shard batcher's own ``free_pages``
+  closure would compute: queued + in-flight requests' worst-case page
+  needs; the device allocator stays the safety net, exactly the
+  single-engine discipline), plus the shard's mesh device and name.
+- :class:`ShardedPoolView` — the aggregate the router routes over:
+  total capacity scales with shard count, ``least_pressure`` picks
+  the shard with the most free pages (ties to the lowest id, so
+  routing is deterministic on a replayed stream).
+
+Placement rides :func:`beholder_tpu.parallel.mesh.
+serving_shard_devices` — one device per shard, cycling over the mesh
+(on a CPU test mesh the forced host-platform devices; on TPU the
+chips), so each shard's pages and page table live on their own chip
+and the only cross-device traffic is the page-granular handoff
+(:mod:`.transfer`).
+"""
+
+from __future__ import annotations
+
+
+class ShardPool:
+    """Host-side view of one decode shard's paged pool."""
+
+    def __init__(self, shard_id: int, num_pages: int, device=None):
+        self.shard_id = shard_id
+        self.name = f"decode-{shard_id}"
+        self.num_pages = int(num_pages)
+        self.device = device
+        #: worst-case pages reserved by queued + in-flight requests
+        #: (host arithmetic — never a device read)
+        self.committed = 0
+
+    @property
+    def free(self) -> int:
+        return self.num_pages - self.committed
+
+    def reserve(self, pages: int) -> None:
+        self.committed += int(pages)
+
+    def release(self, pages: int) -> None:
+        self.committed -= int(pages)
+        if self.committed < 0:  # defensive: accounting must never wedge
+            self.committed = 0
+
+    def fits(self, pages: int) -> bool:
+        """Whether a request of worst-case ``pages`` can EVER run on
+        this shard (the per-shard twin of ``_check_servable``'s pool
+        bound; the per-seq table cap stays the batcher's check)."""
+        return pages <= self.num_pages
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"ShardPool({self.name}, free={self.free}/{self.num_pages})"
+        )
+
+
+class ShardedPoolView:
+    """The router's aggregate over every shard's page arithmetic."""
+
+    def __init__(self, shards: list[ShardPool]):
+        if not shards:
+            raise ValueError("a cluster needs at least one shard pool")
+        self.shards = shards
+
+    @property
+    def total_pages(self) -> int:
+        return sum(s.num_pages for s in self.shards)
+
+    @property
+    def total_free(self) -> int:
+        return sum(s.free for s in self.shards)
+
+    def least_pressure(self) -> ShardPool:
+        """The shard with the most free pages; ties break to the
+        lowest shard id so a replayed stream routes identically."""
+        return max(self.shards, key=lambda s: (s.free, -s.shard_id))
+
+    def refresh_gauges(self, instruments) -> None:
+        """Export every shard's free/committed pages on the labelled
+        cluster gauges (no-op without instruments)."""
+        if instruments is None:
+            return
+        for shard in self.shards:
+            instruments.set_shard_pool(
+                str(shard.shard_id), shard.free, shard.committed
+            )
+
+
+def place_paged_state(state, device):
+    """Commit one shard's :class:`~beholder_tpu.models.serving.
+    PagedKVState` (and anything else pytree-shaped, e.g. params) onto
+    its mesh device. Committed state pins every jit the shard batcher
+    dispatches to that device — the pool partition IS the placement."""
+    import jax
+
+    if device is None:
+        return state
+    return jax.device_put(state, device)

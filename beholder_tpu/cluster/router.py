@@ -1,0 +1,593 @@
+"""The cluster scheduler: the batcher promoted to an admission router.
+
+One :class:`ClusterScheduler` owns N decode shards (each a full
+:class:`~beholder_tpu.models.serving.ContinuousBatcher` over its own
+per-shard paged pool on its own mesh device) and, optionally, M
+dedicated prefill workers (:class:`~beholder_tpu.cluster.transfer.
+PrefillWorker`). The caller-facing API is the batcher's own —
+``run(requests)`` / ``submit(request)`` + ``run_pending()`` — so the
+cluster layer is invisible to callers: same contract, same bitwise
+outputs under exact greedy, N× the pool.
+
+Scheduling structure:
+
+- **Routing** (:meth:`_route`): by pool pressure per shard (most free
+  worst-case pages; deterministic tie-break) or round-robin. Every
+  decision lands on ``beholder_cluster_routes_total{reason}`` and as a
+  recorder-only ``route`` phase event.
+- **Claiming**: every lane claims (slot, request) pairs through the
+  ONE shared ``ContinuousBatcher._claim_admissions`` loop — colocated
+  shards via their untouched ``run()``/``run_spec()`` (so prefix-cache
+  pins and spec rollback refcounts hold per shard exactly as the
+  single-engine tests pin them), the disaggregated loop by calling it
+  directly with its own headroom/commit closures before handoff
+  admission.
+- **Disaggregation** (:meth:`_run_disaggregated`): claimed requests
+  prefill on a prefill worker, the KV hands off page-granularly to the
+  owning shard (:class:`~beholder_tpu.cluster.transfer.
+  PageTransferEngine`), and the decode loop ticks on the shard's own
+  pool — long prefills occupy prefill-worker FLOPs, not the decode
+  shard's tick cadence. Shards with a prefix cache or spec config
+  serve colocated (their scheduler composes those subsystems; the
+  handoff path is the plain exact-decode fast lane).
+- **Rebalance on horizon** (:meth:`_rebalance`): at drain time —
+  i.e. after retirements freed capacity — queued requests that no
+  longer fit their shard migrate to the least-pressure shard
+  (``reason="rebalance"``), so one hot shard cannot starve while
+  another idles.
+
+Instrumentation is host-side only (zero device reads, the serving
+discipline): cluster series register only when a registry is wired,
+``route``/``transfer``/``prefill`` are recorder-only events (the
+round-histogram label set stays exactly the single-engine one), and
+per-shard shed attribution rides each shard's uniquely named intake
+queue (``beholder_intake_shed_total{queue, reason}``).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from . import ROUTE_ROUND_ROBIN, ClusterConfig
+from .pool import ShardedPoolView, ShardPool, place_paged_state
+from .transfer import PageTransferEngine, PrefillWorker
+
+
+class _Shard:
+    """One decode shard: pool view + batcher + bounded intake."""
+
+    def __init__(self, pool: ShardPool, batcher, intake):
+        self.pool = pool
+        self.batcher = batcher
+        self.intake = intake
+
+
+class ClusterScheduler:
+    """Cluster-level serving over sharded paged pools.
+
+    ``batcher_kwargs`` are the per-shard
+    :class:`~beholder_tpu.models.serving.ContinuousBatcher` knobs
+    (``num_pages`` — PER SHARD — ``page_size``, ``slots``,
+    ``max_prefix``, ``max_pages_per_seq``, ``cache_dtype``).
+    ``prefix_cache_factory`` builds one
+    :class:`~beholder_tpu.cache.PrefixCache` PER SHARD (page ids are
+    shard-local, so shards cannot share an index); ``spec`` is a
+    shared :class:`~beholder_tpu.spec.SpecConfig` (per-shard drafters
+    and controllers build lazily inside each batcher)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        cluster: ClusterConfig,
+        *,
+        metrics=None,
+        tracer=None,
+        flight_recorder=None,
+        prefix_cache_factory=None,
+        spec=None,
+        **batcher_kwargs,
+    ):
+        from beholder_tpu.models.serving import ContinuousBatcher
+        from beholder_tpu.parallel.mesh import serving_shard_devices
+        from beholder_tpu.reliability.shed import IntakeQueue
+
+        self.cluster = cluster
+        self.model = model
+        self.flight_recorder = flight_recorder
+        self._registry = (
+            getattr(metrics, "registry", metrics)
+            if metrics is not None
+            else None
+        )
+        self.instruments = None
+        if self._registry is not None:
+            from .instruments import ClusterMetrics
+
+            self.instruments = ClusterMetrics(self._registry)
+            self.instruments.shards.set(cluster.n_decode_workers)
+
+        n_workers = cluster.n_decode_workers + cluster.n_prefill_workers
+        devices = serving_shard_devices(n_workers)
+
+        self.shards: list[_Shard] = []
+        for i in range(cluster.n_decode_workers):
+            batcher = ContinuousBatcher(
+                model,
+                params,
+                metrics=metrics,
+                tracer=tracer,
+                flight_recorder=flight_recorder,
+                prefix_cache=(
+                    prefix_cache_factory()
+                    if prefix_cache_factory is not None
+                    else None
+                ),
+                spec=spec,
+                **batcher_kwargs,
+            )
+            # the pool partition IS the placement: this shard's pages,
+            # page table and params live on their own mesh device, so
+            # every dispatch the shard runs lands there
+            batcher.state = place_paged_state(batcher.state, devices[i])
+            batcher.params = place_paged_state(batcher.params, devices[i])
+            pool = ShardPool(i, batcher.num_pages, device=devices[i])
+            # the router owns the shard intakes: queued items are
+            # (submit sequence, request) pairs so run_pending() can
+            # hand results back in ADMISSION order across the whole
+            # cluster (the batcher's own contract) no matter how
+            # routing and rebalance interleaved the shards
+            intake = IntakeQueue(
+                cluster.max_pending_per_shard,
+                max_cost=(
+                    cluster.max_pending_pages_per_shard
+                    if cluster.max_pending_pages_per_shard is not None
+                    else batcher.num_pages
+                ),
+                cost_fn=lambda item, b=batcher: b._need_pages(item[1]),
+                metrics=metrics,
+                name=f"cluster.{pool.name}",
+                labelled_sheds=True,
+            )
+            batcher.intake = intake
+            self.shards.append(_Shard(pool, batcher, intake))
+        self.pool_view = ShardedPoolView([s.pool for s in self.shards])
+
+        self.prefill_workers: list[PrefillWorker] = [
+            PrefillWorker(
+                model,
+                params,
+                batcher_kwargs.get("page_size", 16),
+                device=devices[cluster.n_decode_workers + j],
+                name=f"prefill-{j}",
+            )
+            for j in range(cluster.n_prefill_workers)
+        ]
+        self.transfer = PageTransferEngine(
+            instruments=self.instruments,
+            flight_recorder=flight_recorder,
+        )
+        self._rr = 0
+        self._pf_rr = 0
+        #: monotone submit sequence — the admission-order key
+        self._seq = 0
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def total_pages(self) -> int:
+        return self.pool_view.total_pages
+
+    @property
+    def disaggregated(self) -> bool:
+        return bool(self.prefill_workers)
+
+    # -- routing ---------------------------------------------------------
+
+    def _need(self, request) -> int:
+        # shards share geometry, so any batcher's arithmetic serves
+        return self.shards[0].batcher._need_pages(request)
+
+    def _record_route(self, shard: _Shard, reason: str, need: int,
+                      dur_s: float, ts_s: float) -> None:
+        if self.instruments is not None:
+            self.instruments.routes_total.inc(reason=reason)
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(
+                "route", ts_s, dur_s,
+                worker=shard.pool.name, reason=reason, need=int(need),
+            )
+
+    def _route(self, need: int) -> _Shard:
+        """Pick the shard for one request of worst-case ``need`` pages
+        and record the decision (counter + recorder-only event)."""
+        ts = time.time()
+        t0 = time.perf_counter()
+        if len(self.shards) == 1:
+            shard, reason = self.shards[0], "only_shard"
+        elif self.cluster.route_policy == ROUTE_ROUND_ROBIN:
+            shard = self.shards[self._rr % len(self.shards)]
+            self._rr += 1
+            reason = "round_robin"
+        else:
+            target = self.pool_view.least_pressure()
+            shard = self.shards[target.shard_id]
+            reason = "pressure"
+        self._record_route(
+            shard, reason, need, time.perf_counter() - t0, ts
+        )
+        return shard
+
+    def _next_prefill_worker(self) -> PrefillWorker:
+        worker = self.prefill_workers[
+            self._pf_rr % len(self.prefill_workers)
+        ]
+        self._pf_rr += 1
+        return worker
+
+    # -- the batcher-shaped API ------------------------------------------
+
+    def run(self, requests: list) -> list[np.ndarray]:
+        """Serve ``requests`` across the cluster; results are the same
+        per-request forecast delta arrays the single-device engine
+        returns, in the SAME order — routing is invisible to callers.
+        Under exact greedy the streams are bitwise-identical to one
+        :meth:`~beholder_tpu.models.serving.ContinuousBatcher.run` over
+        the same stream (pinned by ``tests/test_cluster.py``)."""
+        results: list = [None] * len(requests)
+        assignments: dict[int, list[tuple[int, object, int]]] = {
+            s.pool.shard_id: [] for s in self.shards
+        }
+        for gid, req in enumerate(requests):
+            need = self._need(req)
+            shard = self._route(need)
+            shard.pool.reserve(need)
+            assignments[shard.pool.shard_id].append((gid, req, need))
+        self.pool_view.refresh_gauges(self.instruments)
+        for shard in self.shards:
+            items = assignments[shard.pool.shard_id]
+            if not items:
+                continue
+            served = self._serve(shard, [req for _, req, _ in items])
+            for (gid, _, need), res in zip(items, served):
+                results[gid] = res
+                shard.pool.release(need)
+            if self.instruments is not None:
+                self.instruments.requests_total.inc(
+                    len(items), shard=str(shard.pool.shard_id)
+                )
+        self.pool_view.refresh_gauges(self.instruments)
+        return results
+
+    def submit(self, request):
+        """Offer one request to the cluster: route, then the owning
+        shard's bounded intake decides — an explicit
+        :class:`~beholder_tpu.reliability.shed.Admission`, with sheds
+        attributed to the shard's queue
+        (``beholder_intake_shed_total{queue, reason}``)."""
+        from beholder_tpu.reliability.shed import SHED_OVERSIZED
+
+        need = self._need(request)
+        shard = self._route(need)
+        batcher = shard.batcher
+        if need > batcher.num_pages or need > batcher.max_pages_per_seq:
+            # unservable at ANY load (the batcher's own submit rule)
+            return shard.intake.shed(SHED_OVERSIZED)
+        admission = shard.intake.offer((self._seq, request), cost=need)
+        if admission.accepted:
+            self._seq += 1
+            shard.pool.reserve(need)
+            self.pool_view.refresh_gauges(self.instruments)
+        return admission
+
+    def run_pending(self) -> list[np.ndarray]:
+        """Rebalance queued work across shards (capacity freed by
+        retirements since the last drain makes moves possible — the
+        'rebalance on horizon' step), then drain and serve every
+        shard. Results come back in ADMISSION order across the whole
+        cluster — the single-engine ``run_pending`` contract; routing
+        and rebalance stay invisible to callers."""
+        self._rebalance()
+        collected: list[tuple[int, np.ndarray]] = []
+        for shard in self.shards:
+            pending = shard.intake.take_all()
+            if not pending:
+                continue
+            requests = [req for _, req in pending]
+            served = self._serve(shard, requests)
+            for req in requests:
+                shard.pool.release(self._need(req))
+            collected.extend(
+                zip((seq for seq, _ in pending), served)
+            )
+            if self.instruments is not None:
+                self.instruments.requests_total.inc(
+                    len(pending), shard=str(shard.pool.shard_id)
+                )
+        self.pool_view.refresh_gauges(self.instruments)
+        collected.sort(key=lambda pair: pair[0])
+        return [result for _, result in collected]
+
+    def _serve(self, shard: _Shard, requests: list) -> list[np.ndarray]:
+        batcher = shard.batcher
+        if (
+            self.prefill_workers
+            and batcher.prefix_cache is None
+            and batcher.spec is None
+        ):
+            return self._run_disaggregated(shard, requests)
+        if batcher.spec is not None:
+            return batcher.run_spec(requests)
+        return batcher.run(requests)
+
+    # -- rebalance -------------------------------------------------------
+
+    def _rebalance(self) -> None:
+        """Re-pack queued requests across shards: a queued request
+        whose shard can no longer hold its worst case (pages freed
+        elsewhere, arrivals skewed) migrates to the least-pressure
+        shard that fits it. Items move via
+        :meth:`~beholder_tpu.reliability.shed.IntakeQueue.restock` —
+        they were admitted once; rebalancing must not re-count (or
+        re-shed) them."""
+        if len(self.shards) < 2:
+            return
+        drained = {
+            s.pool.shard_id: s.intake.take_all() for s in self.shards
+        }
+        if not any(drained.values()):
+            return
+        # queued commitments come off while we re-pack (in-flight ones,
+        # if any, stay reserved)
+        needs: dict[int, list[int]] = {}
+        for shard in self.shards:
+            needs[shard.pool.shard_id] = [
+                self._need(req) for _, req in drained[shard.pool.shard_id]
+            ]
+            shard.pool.release(sum(needs[shard.pool.shard_id]))
+        final: dict[int, list] = {s.pool.shard_id: [] for s in self.shards}
+        for shard in self.shards:
+            sid = shard.pool.shard_id
+            for item, need in zip(drained[sid], needs[sid]):
+                target = shard
+                if shard.pool.free < need:
+                    best = self.pool_view.least_pressure()
+                    if best.shard_id != sid and best.free >= need:
+                        target = self.shards[best.shard_id]
+                        ts = time.time()
+                        self._record_route(
+                            target, "rebalance", need, 0.0, ts
+                        )
+                final[target.pool.shard_id].append(item)
+                target.pool.reserve(need)
+        for shard in self.shards:
+            shard.intake.restock(final[shard.pool.shard_id])
+        self.pool_view.refresh_gauges(self.instruments)
+
+    # -- the disaggregated serving loop ----------------------------------
+
+    def _run_disaggregated(
+        self, shard: _Shard, requests: list
+    ) -> list[np.ndarray]:
+        """Prefill-on-worker, decode-on-shard serving: the per-event
+        scheduler's loop (claim under page headroom -> admit -> tick
+        the event-free stretch -> retire -> one packed readback) with
+        admission replaced by the handoff pipeline (prefill ->
+        transfer -> adopt). Bitwise contract: a slot's stream depends
+        only on its own pages and carry seed, and the handoff writes
+        both exactly as a colocated admit would."""
+        b = shard.batcher
+        b._start_run(requests)
+        t0 = time.perf_counter()
+        try:
+            with b._run_span(
+                "serving.run_cluster",
+                requests=len(requests),
+                shard=shard.pool.name,
+            ) as span:
+                results = self._disagg_loop(shard, requests, span)
+        except BaseException:
+            b._poisoned = True
+            raise
+        if b._metrics:
+            b._metrics.observe_run(
+                "run_cluster",
+                time.perf_counter() - t0,
+                sum(max(r.horizon, 0) for r in requests),
+                trace_id=b._span_trace_id(span),
+            )
+        return results
+
+    def _disagg_loop(self, shard: _Shard, requests, span):
+        import jax
+        import jax.numpy as jnp
+
+        from beholder_tpu.models.serving import (
+            _adopt_chunks_carry,
+            _RunCarry,
+        )
+        from beholder_tpu.ops import NUM_STATUSES
+
+        b = shard.batcher
+        fr = self.flight_recorder
+        queue = list(enumerate(requests))
+        results: list = [None] * len(requests)
+        cap = max(1, max((r.horizon for r in requests), default=1) - 1)
+        carry = _RunCarry(
+            jnp.zeros((b.slots,), jnp.float32),
+            jnp.zeros((b.slots, NUM_STATUSES), jnp.float32),
+            jnp.zeros((b.slots, cap), jnp.float32),
+        )
+        req_of = [None] * b.slots
+        remaining = np.zeros(b.slots, np.int64)
+        total_need = np.zeros(b.slots, np.int64)
+        written = np.zeros(b.slots, np.int64)
+        snap_batches: list = []
+        served = [0, 0]
+
+        def free_pages() -> int:
+            return b.num_pages - int(total_need.sum())
+
+        # retire_many and the packed readback below deliberately mirror
+        # _run()'s — folding all three serving loops into one composable
+        # step pipeline is ROADMAP open item 2; until then a change to
+        # _run's snapshot/readback packing must be mirrored here (the
+        # bitwise-identity test fails loudly if they drift)
+        def retire_many(done: list[int]):
+            with b._round(span, "retire", slots=len(done)):
+                idx = jnp.asarray(done, jnp.int32)
+                rids = [req_of[s] for s in done]
+                snap_batches.append((
+                    rids,
+                    carry.delta_buf[idx],
+                    carry.last_pred[idx],
+                    [int(written[s]) for s in done],
+                ))
+                b.state = b._release_many(b.state, idx)
+                for s in done:
+                    req_of[s] = None
+                    total_need[s] = 0
+                    written[s] = 0
+                served[0] += len(done)
+                served[1] += sum(requests[r].horizon for r in rids)
+
+        while queue or any(r is not None for r in req_of):
+            # claim round: ONE copy of the hardening invariants
+            # (headroom arithmetic, pressure deferral + stall marker,
+            # exhaustion fail-fast, recorder-only claim event) — the
+            # batcher's own shared claim loop; its prefix-cache branch
+            # is inert here (the disagg lane is guarded to
+            # prefix_cache=None — warm traffic serves colocated)
+            def commit(slot, rid, req, need):
+                remaining[slot] = req.horizon
+                total_need[slot] = need
+                written[slot] = 0
+
+            batch = b._claim_admissions(
+                queue, results, req_of, free_pages, commit
+            )
+
+            for slot, rid, feats_np, t, _hit, _hashes in batch:
+                # prefill on a dedicated worker (recorder-only event,
+                # flash-family kernel tags — the prefill FLOPs moved
+                # OFF this shard is exactly what the timeline shows)
+                worker = self._next_prefill_worker()
+                pf_ts = time.time() if fr is not None else 0.0
+                pf_t0 = time.perf_counter()
+                pred, chunks_k, chunks_v, n_pages = worker.prefill(
+                    feats_np, t
+                )
+                if fr is not None:
+                    fr.record(
+                        "prefill", pf_ts,
+                        time.perf_counter() - pf_t0,
+                        worker=worker.name, slot=slot, tokens=int(t),
+                        **b._kernel_tags(
+                            "flash", t * b._flops_per_token(t / 2.0)
+                        ),
+                    )
+                # page-granular handoff to the owning shard
+                pred, chunks_k, chunks_v = self.transfer.handoff(
+                    pred, chunks_k, chunks_v, n_pages,
+                    shard.pool.device, src=worker.name,
+                    dst=shard.pool.name,
+                )
+                # adopt into the shard pool + seed the decode carry
+                # (the existing admit phase label — no new histogram
+                # labels; the handoff-specific slices are above)
+                with b._round(span, "admit", requests=1):
+                    p_max = chunks_k[0].shape[0]
+                    adopt = b._cached_jit(
+                        ("cluster_adopt", p_max),
+                        lambda: lambda s, c, sl, ck, cv, npg, ln, pr, st: (
+                            _adopt_chunks_carry(
+                                s, c, sl, ck, cv, npg, ln, pr, st
+                            )
+                        ),
+                    )
+                    b.state, carry = adopt(
+                        b.state, carry, jnp.int32(slot),
+                        chunks_k, chunks_v, jnp.int32(n_pages),
+                        jnp.int32(t), pred,
+                        jnp.int32(int(requests[rid].statuses[-1])),
+                    )
+            done = [x[0] for x in batch if remaining[x[0]] == 1]
+            if done:
+                retire_many(done)
+            if b._metrics:
+                b._metrics.slots_active.set(
+                    sum(r is not None for r in req_of)
+                )
+                b._metrics.pool_pages_free.set(free_pages())
+            if not any(r is not None for r in req_of):
+                continue
+
+            active = [r is not None for r in req_of]
+            n_chunk = max(
+                1, int(min(remaining[s] for s in range(b.slots)
+                           if active[s])) - 1
+            )
+            write_idx = np.where(active, written, cap).astype(np.int32)
+            tick_tags = {"ticks": n_chunk, "worker": shard.pool.name}
+            if fr is not None:
+                lens = [
+                    len(requests[req_of[s]].progress) - 1
+                    + int(written[s])
+                    for s in range(b.slots)
+                    if active[s]
+                ]
+                tick_tags.update(b._kernel_tags(
+                    "paged",
+                    n_chunk * len(lens)
+                    * b._flops_per_token(float(np.mean(lens))),
+                ))
+            with b._round(span, "tick", **tick_tags):
+                b.state, carry = b._tick_chunk(
+                    b.params, b.state, carry,
+                    jnp.asarray(write_idx), jnp.int32(n_chunk),
+                )
+            done = []
+            for slot in range(b.slots):
+                if req_of[slot] is None:
+                    continue
+                written[slot] += n_chunk
+                remaining[slot] -= n_chunk
+                if remaining[slot] <= 1:
+                    done.append(slot)
+            if done:
+                retire_many(done)
+                if b._metrics:
+                    b._metrics.slots_active.set(
+                        sum(r is not None for r in req_of)
+                    )
+                    b._metrics.pool_pages_free.set(free_pages())
+
+        # ONE packed readback, exactly the single-engine discipline
+        if snap_batches:
+            with b._round(span, "readback", batches=len(snap_batches)):
+                rows = jnp.concatenate([x[1] for x in snap_batches])
+                tails = jnp.concatenate([x[2] for x in snap_batches])
+                packed = jnp.concatenate(
+                    [
+                        b.state.alloc_failed.astype(jnp.float32)[None],
+                        tails.astype(jnp.float32),
+                        rows.reshape(-1),
+                    ]
+                )
+                got = np.asarray(jax.device_get(packed), np.float32)
+            if got[0]:
+                raise RuntimeError(b._ALLOCATOR_TRIPPED)
+            rids = [rid for x in snap_batches for rid in x[0]]
+            widths = [w for x in snap_batches for w in x[3]]
+            r = len(rids)
+            tails_v = got[1 : 1 + r]
+            rows_v = got[1 + r :].reshape(r, cap)
+            for i, (rid, w) in enumerate(zip(rids, widths)):
+                results[rid] = np.append(rows_v[i, :w], tails_v[i])
+        elif bool(jax.device_get(b.state.alloc_failed)):
+            raise RuntimeError(b._ALLOCATOR_TRIPPED)
+        if b._metrics:
+            b._metrics.served(*served)
+        return results

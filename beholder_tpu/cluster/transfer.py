@@ -1,0 +1,141 @@
+"""Prefill workers + the page-granular KV handoff between workers.
+
+Disaggregation splits one admission into three device-side steps:
+
+1. **Prefill** on a dedicated prefill worker:
+   :func:`~beholder_tpu.models.serving.kv_prefill_chunks` runs the
+   same prefill forward a colocated admit runs, but returns the KV as
+   page-layout chunks instead of scattering it into a local pool —
+   prefill workers own FLOPs, not pages.
+2. **Transfer**: the chunks (plus the admit prediction riding along)
+   move to the owning decode shard's device in one
+   ``jax.device_put`` — page-granular, so the wire unit is the same
+   unit the pool allocates. On TPU this is the ICI/DMA hop; on a CPU
+   test mesh it is a host copy; either way the content is
+   bit-preserved (pinned by ``tests/test_cluster.py``).
+3. **Adopt** on the decode shard:
+   :func:`~beholder_tpu.models.serving.paged_adopt_chunks` pops pages
+   off THAT shard's free stack and writes the chunks through the same
+   cast/quantize path a local prefill would have used — the
+   destination pool ends up byte-identical to a colocated admit.
+
+The handoff is instrumented twice, both host-side: the
+``beholder_cluster_transfer*`` counters (:mod:`.instruments`) and a
+recorder-only ``transfer`` phase event carrying the worker pair (the
+flight-recorder satellite — it must NOT appear as a new
+round-histogram label, so it records straight to the ring like the
+``claim`` phase).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class PrefillWorker:
+    """A dedicated prefill worker: the model forward on its own mesh
+    device, producing handoff chunks instead of pool writes.
+
+    Stateless by design — pure prefill holds no pages (nothing decodes
+    here), so the worker is just committed params + a jit cache keyed
+    on the padded prefix width."""
+
+    def __init__(self, model, params, page_size: int, device=None,
+                 name: str = "prefill-0"):
+        from beholder_tpu.cluster.pool import place_paged_state
+
+        self.model = model
+        self.page_size = int(page_size)
+        self.device = device
+        self.name = name
+        self.params = place_paged_state(params, device)
+        self._jits: dict[int, object] = {}
+
+    def _fn(self, t_pad: int):
+        fn = self._jits.get(t_pad)
+        if fn is None:
+            import jax
+
+            from beholder_tpu.models.serving import kv_prefill_chunks
+
+            fn = jax.jit(
+                lambda p, f, ln: kv_prefill_chunks(
+                    self.model, p, f, ln, self.page_size
+                )
+            )
+            self._jits[t_pad] = fn
+        return fn
+
+    def prefill(self, feats_np, t: int):
+        """Prefill one request's (t, F) features; returns
+        ((,) admit prediction, per-layer k chunks, per-layer v chunks,
+        live page count) — device arrays on THIS worker's device,
+        ready for :meth:`PageTransferEngine.handoff`."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        t_pad = -(-t // self.page_size) * self.page_size
+        n_pages = -(-t // self.page_size)
+        padded = np.pad(feats_np, ((0, t_pad - feats_np.shape[0]), (0, 0)))
+        pred, chunks_k, chunks_v = self._fn(t_pad)(
+            self.params, jnp.asarray(padded)[None], jnp.int32(t)
+        )
+        return pred, chunks_k, chunks_v, n_pages
+
+
+class PageTransferEngine:
+    """Moves prefilled KV chunks to the owning decode shard.
+
+    Counts every handoff host-side (``transfers`` / ``pages`` /
+    ``bytes`` mirror the ``beholder_cluster_transfer*`` counters when
+    a registry is wired, and exist without one so tests and the bench
+    can read them directly), and records a recorder-only ``transfer``
+    phase event per handoff with the (src, dst) worker pair — the
+    timeline shows WHICH workers the pages crossed between, one track
+    per worker in the Chrome trace export."""
+
+    def __init__(self, instruments=None, flight_recorder=None):
+        self.instruments = instruments
+        self.flight_recorder = flight_recorder
+        self.transfers = 0
+        self.pages = 0
+        self.bytes = 0
+
+    @staticmethod
+    def _live_bytes(chunks_k, chunks_v, n_pages: int) -> int:
+        """Bytes of LIVE pages moved (the dead static-width tail is
+        masked off at adopt, but device_put moves it too — the counter
+        reports the page-granular payload, the honest fabric figure)."""
+        per_page = 0
+        for c in (*chunks_k, *chunks_v):
+            # (p_max, Hkv, Dh, page) -> bytes of one page row
+            per_page += int(c.size // c.shape[0]) * c.dtype.itemsize
+        return per_page * int(n_pages)
+
+    def handoff(self, pred, chunks_k, chunks_v, n_pages: int, dst_device,
+                src: str, dst: str):
+        """Move (pred, chunks) to ``dst_device``; returns the moved
+        pytree. ``dst_device=None`` keeps the arrays where they are
+        (single-device fallback) but still counts — the handoff
+        happened, the fabric hop was just free."""
+        import jax
+
+        fr = self.flight_recorder
+        ts = time.time() if fr is not None else 0.0
+        t0 = time.perf_counter()
+        if dst_device is not None:
+            pred, chunks_k, chunks_v = jax.device_put(
+                (pred, chunks_k, chunks_v), dst_device
+            )
+        nbytes = self._live_bytes(chunks_k, chunks_v, n_pages)
+        self.transfers += 1
+        self.pages += int(n_pages)
+        self.bytes += nbytes
+        if self.instruments is not None:
+            self.instruments.observe_transfer(int(n_pages), nbytes)
+        if fr is not None:
+            fr.record(
+                "transfer", ts, time.perf_counter() - t0,
+                worker=dst, src=src, pages=int(n_pages), bytes=nbytes,
+            )
+        return pred, chunks_k, chunks_v

@@ -110,10 +110,16 @@ class Counter(_Labelled):
         with self._lock:
             return sum(self._values.values())
 
-    def render(self) -> str:
+    def items(self) -> list[tuple[tuple[str, ...], float]]:
+        """Per-label-combination ``(key, value)`` snapshot, keys
+        ordered by ``labelnames`` — public introspection for artifact
+        folds (e.g. the cluster block's sheds-by-queue) so callers
+        never reach into the storage dict."""
         with self._lock:
-            items = sorted(self._values.items())
-        return self._render_simple("counter", items)
+            return sorted(self._values.items())
+
+    def render(self) -> str:
+        return self._render_simple("counter", self.items())
 
 
 class _BoundCounter:

@@ -506,6 +506,114 @@ def paged_admit_with_prefix(
     )
 
 
+def kv_prefill_chunks(
+    model: TelemetrySequenceModel,
+    params,
+    feats_padded: jax.Array,
+    prefix_len: jax.Array,
+    page_size: int,
+):
+    """Prefill ONE request OFF-POOL for a prefill->decode handoff
+    (:mod:`beholder_tpu.cluster`): run the same batched-prefill forward
+    :func:`paged_admit_batch` runs, but instead of scattering the KV
+    into THIS worker's pool, return it as page-granular chunks —
+    per-layer ``(p_max, Hkv, Dh, page)`` arrays in pool layout, the
+    unit :func:`paged_adopt_chunks` writes into a DIFFERENT shard's
+    pool after a device-to-device transfer.
+
+    The chunk construction is byte-for-byte the transpose/reshape
+    ``paged_admit_batch`` feeds :func:`_write_chunks`, and the chunks
+    stay in the forward's dtype (the adopting shard's
+    ``_write_chunks`` applies the same cast/quantize the colocated
+    admit would), so a handoff admit leaves the destination pool
+    bitwise-identical to a local prefill of the same request.
+
+    ``feats_padded`` is (1, T_max, F) with page-multiple T_max.
+    Returns ((,) last prediction, per-layer k chunks tuple, per-layer
+    v chunks tuple)."""
+    n, t_max, _ = feats_padded.shape
+    if n != 1:
+        raise ValueError(f"kv_prefill_chunks takes ONE request, got {n}")
+    if t_max % page_size:
+        raise ValueError(
+            f"padded prefix {t_max} not a page multiple ({page_size})"
+        )
+    p_max = t_max // page_size
+
+    preds, kvs = model.apply(params, feats_padded, return_kv=True)
+    last_pred = preds[0, jnp.clip(prefix_len - 1, 0, t_max - 1)]
+
+    def chunks(a):
+        # (1, Hkv, T_max, Dh) -> (p_max, Hkv, Dh, page) — the exact
+        # layout paged_admit_batch scatters (its n == 1 case)
+        hkv, dh = a.shape[1], a.shape[3]
+        a = a.transpose(0, 1, 3, 2)                 # (1, Hkv, Dh, T)
+        a = a.reshape(1, hkv, dh, p_max, page_size)
+        return a.transpose(0, 3, 1, 2, 4).reshape(
+            p_max, hkv, dh, page_size
+        )
+
+    chunks_k = tuple(chunks(k) for k, _ in kvs)
+    chunks_v = tuple(chunks(v) for _, v in kvs)
+    return last_pred, chunks_k, chunks_v
+
+
+def paged_adopt_chunks(
+    state: PagedKVState,
+    slot: jax.Array,
+    chunks_k: tuple,
+    chunks_v: tuple,
+    n_pages: jax.Array,
+    seq_len: jax.Array,
+) -> PagedKVState:
+    """Shard-aware pool op: admit one request whose prefill KV arrives
+    as page chunks from ANOTHER worker (:func:`kv_prefill_chunks` +
+    the cluster transfer engine) — pop ``n_pages`` pages off THIS
+    shard's free stack, write the transferred chunks through the same
+    :func:`_write_chunks` path a local prefill uses (cast/quantize
+    included, so pool content is bitwise what a colocated admit would
+    have written), and install the slot's page-table row, length, and
+    active bit. The dead tail of the static-width chunks (rows past
+    ``n_pages``) is masked off exactly like ``paged_admit_batch``'s
+    chunk_alive handling."""
+    num_pages, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    p_max = chunks_k[0].shape[0]
+    chunk_alive = jnp.arange(p_max) < n_pages
+    pages, new_top, ref, failed = _pop_pages(state, chunk_alive)
+    failed = failed | (n_pages > max_pages)
+    drop = jnp.where(chunk_alive, pages, num_pages)
+
+    k_pools = tuple(
+        _write_chunks(pool, drop, ck)
+        for pool, ck in zip(state.k_pools, chunks_k)
+    )
+    v_pools = tuple(
+        _write_chunks(pool, drop, cv)
+        for pool, cv in zip(state.v_pools, chunks_v)
+    )
+
+    row = jnp.concatenate(
+        [
+            jnp.where(chunk_alive, pages, 0),
+            jnp.zeros((max(0, max_pages - p_max),), jnp.int32),
+        ]
+    )[:max_pages]
+    safe_slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0, slots - 1)
+    return state._replace(
+        k_pools=k_pools,
+        v_pools=v_pools,
+        page_table=state.page_table.at[safe_slot].set(row),
+        seq_lens=state.seq_lens.at[safe_slot].set(
+            jnp.asarray(seq_len, jnp.int32)
+        ),
+        active=state.active.at[safe_slot].set(True),
+        free_top=new_top,
+        page_ref=ref,
+        alloc_failed=failed,
+    )
+
+
 def cache_ref_pages(
     state: PagedKVState, page_ids: jax.Array, alive: jax.Array
 ) -> PagedKVState:
@@ -814,6 +922,28 @@ def _admit_cached_carry(
     suffix) dwarfs the extra dispatch."""
     pred, state = paged_admit_with_prefix(
         model, params, state, slot, suffix_feats, suffix_len, cached_pages
+    )
+    slot = jnp.asarray(slot, jnp.int32)
+    return state, carry._replace(
+        last_pred=carry.last_pred.at[slot].set(pred.astype(jnp.float32)),
+        status_oh=carry.status_oh.at[slot].set(
+            jax.nn.one_hot(last_status, NUM_STATUSES)
+        ),
+    )
+
+
+def _adopt_chunks_carry(
+    state, carry: _RunCarry, slot, chunks_k, chunks_v, n_pages, seq_len,
+    pred, last_status,
+):
+    """Admit one TRANSFERRED request (:func:`paged_adopt_chunks`) and
+    record its prefill prediction + status one-hot in the device
+    carry — the handoff twin of :func:`_admit_many_carry`. The
+    prediction was computed by the prefill worker's forward and rides
+    the transfer with the chunks; the same ``astype(float32)`` the
+    colocated admit applies keeps the carry seed bitwise identical."""
+    state = paged_adopt_chunks(
+        state, slot, chunks_k, chunks_v, n_pages, seq_len
     )
     slot = jnp.asarray(slot, jnp.int32)
     return state, carry._replace(

@@ -79,6 +79,30 @@ def place_state(state: Any, mesh: Mesh) -> Any:
     return jax.device_put(state, state_shardings(state, mesh))
 
 
+# -- serving-shard placement for the paged KV pool ---------------------------
+#
+# The cluster subsystem (beholder_tpu.cluster) partitions the paged
+# serving state by WORKER, not by array axis: each decode shard's whole
+# PagedKVState (pools + page table + free stack + refcounts) commits to
+# one device, and the only cross-device traffic is the page-granular
+# prefill->decode handoff. That is deliberately NOT a GSPMD sharding —
+# the pool's free-stack pop/push is a sequential stack discipline that
+# partitions cleanly per shard (per-shard free lists) but not across a
+# named mesh axis.
+
+
+def serving_shard_devices(n_workers: int) -> list:
+    """One device per serving worker (decode shards first, then
+    prefill workers), cycling over the available devices — on a forced
+    host-platform CPU mesh the virtual devices, on TPU the chips. More
+    workers than devices co-locate round-robin (capacity arithmetic
+    still shards; the fabric hop degrades to a local copy)."""
+    if n_workers < 1:
+        raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+    devices = jax.devices()
+    return [devices[i % len(devices)] for i in range(n_workers)]
+
+
 # -- megatron tensor parallelism for the transformer ------------------------
 #
 # Column-parallel (output dim sharded over tp): q/k/v projections and the

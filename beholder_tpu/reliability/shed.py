@@ -64,6 +64,16 @@ class IntakeQueue:
     ``beholder_mq_queue_depth{queue}`` (PR 1 instrumented MQ depth but
     left the serving intake path an unlabelled singleton; multiple
     intakes in one process now chart side by side).
+
+    ``labelled_sheds`` (off by default so the existing exposition is
+    untouched) additionally attributes every shed to THIS queue on the
+    labelled ``beholder_intake_shed_total{queue, reason}`` series —
+    the shed twin of the labelled depth gauge. The cluster router
+    turns it on for its per-shard intakes (uniquely named
+    ``cluster.decode-<i>``), so shed attribution survives the move
+    from one queue to N: which SHARD said no stays chartable after
+    the reason-only ``beholder_serving_shed_total`` series folds all
+    shards together.
     """
 
     def __init__(
@@ -73,6 +83,7 @@ class IntakeQueue:
         cost_fn: Callable[[Any], float] | None = None,
         metrics=None,
         name: str | None = None,
+        labelled_sheds: bool = False,
     ):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
@@ -96,6 +107,7 @@ class IntakeQueue:
         self._shed_total = None
         self._depth_gauge = None
         self._labelled_depth = None
+        self._labelled_sheds = None
         self._admitted_total = None
         if metrics is not None:
             registry = getattr(metrics, "registry", metrics)
@@ -123,6 +135,15 @@ class IntakeQueue:
                 labelnames=["queue"],
             )
             self._labelled_depth.set(0, queue=self.name)
+            if labelled_sheds:
+                self._labelled_sheds = get_or_create(
+                    registry, "counter",
+                    "beholder_intake_shed_total",
+                    "Requests shed at a bounded intake queue, by queue "
+                    "name and reason (per-queue twin of "
+                    "beholder_serving_shed_total)",
+                    labelnames=["queue", "reason"],
+                )
 
     # -- introspection -------------------------------------------------------
     @property
@@ -140,6 +161,8 @@ class IntakeQueue:
         self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
         if self._shed_total is not None:
             self._shed_total.inc(reason=reason)
+        if self._labelled_sheds is not None:
+            self._labelled_sheds.inc(queue=self.name, reason=reason)
         return Admission(False, reason)
 
     def shed(self, reason: str) -> Admission:
@@ -185,3 +208,28 @@ class IntakeQueue:
             if self._labelled_depth is not None:
                 self._labelled_depth.set(0, queue=self.name)
             return items
+
+    def restock(self, items: list) -> None:
+        """Put back items previously drained by :meth:`take_all` (the
+        cluster router's rebalance re-packs queued work across shard
+        queues). Bypasses the bounds and the admitted/shed counters —
+        every item here was already admitted exactly once; rebalancing
+        must neither re-count nor re-shed it. Restocked items land at
+        the FRONT in order, so a drain sees them before newer offers
+        (FIFO is preserved across a rebalance)."""
+        if not items:
+            return
+        with self._lock:
+            cost = sum(
+                float(self.cost_fn(item)) if self.cost_fn is not None
+                else 0.0
+                for item in items
+            )
+            self._pending = list(items) + self._pending
+            self._pending_cost += cost
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(len(self._pending))
+            if self._labelled_depth is not None:
+                self._labelled_depth.set(
+                    len(self._pending), queue=self.name
+                )

@@ -232,6 +232,18 @@ class BeholderService:
 
         self.flight_recorder = flight_recorder_from_config(config)
 
+        #: optional cluster serving (``instance.cluster.*``; OFF by
+        #: default). A library knob like ``spec``: the service parses
+        #: it once into a :class:`beholder_tpu.cluster.ClusterConfig`
+        #: (service.cluster) for whatever embeds the serving layer
+        #: (``ClusterScheduler(model, params, service.cluster, ...)``).
+        #: Parsing is import-light (no jax) and, disabled, yields
+        #: None — behavior and the default exposition stay
+        #: byte-identical.
+        from beholder_tpu.cluster import cluster_from_config
+
+        self.cluster = cluster_from_config(config)
+
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),

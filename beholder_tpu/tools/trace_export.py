@@ -47,10 +47,24 @@ def load_events(path: str) -> list[dict[str, Any]]:
     return events
 
 
+#: cluster-worker tracks start here — far above any plausible count of
+#: distinct trace ids in one ring, so the two tid namespaces never
+#: collide
+WORKER_TID_BASE = 100_000
+
+
 def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
     """Convert recorder events to the Chrome trace-event format (JSON
-    Array Format with metadata, the Perfetto-compatible subset)."""
+    Array Format with metadata, the Perfetto-compatible subset).
+
+    Events whose args carry a ``worker`` tag (the cluster subsystem's
+    route/transfer/prefill/claim/tick events) get ONE TRACK PER WORKER
+    instead of one per trace id — a disaggregated serving run reads as
+    parallel worker lanes (``worker decode-0``, ``worker prefill-0``,
+    ...), with the page handoffs visible as slices on the destination
+    worker's lane. Worker-less events keep the per-trace tracks."""
     tid_of: dict[str, int] = {}
+    worker_tid_of: dict[str, int] = {}
 
     def tid(trace_id: str | None) -> int:
         if not trace_id:
@@ -58,6 +72,11 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
         if trace_id not in tid_of:
             tid_of[trace_id] = len(tid_of) + 1
         return tid_of[trace_id]
+
+    def worker_tid(worker: str) -> int:
+        if worker not in worker_tid_of:
+            worker_tid_of[worker] = WORKER_TID_BASE + len(worker_tid_of)
+        return worker_tid_of[worker]
 
     trace_events: list[dict[str, Any]] = [
         {
@@ -77,7 +96,8 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
     ]
     for event in events:
         trace_id = event.get("trace_id")
-        row = tid(trace_id)
+        worker = (event.get("args") or {}).get("worker")
+        row = worker_tid(str(worker)) if worker else tid(trace_id)
         out: dict[str, Any] = {
             "name": event["name"],
             "ph": event.get("ph", "X"),
@@ -102,6 +122,17 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
                 "pid": 1,
                 "tid": row,
                 "args": {"name": f"trace {trace_id[:12]}"},
+            }
+        )
+    # ...and one named track per cluster worker
+    for worker, row in worker_tid_of.items():
+        trace_events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": row,
+                "args": {"name": f"worker {worker}"},
             }
         )
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

@@ -1229,6 +1229,167 @@ def bench_spec() -> dict:
     }
 
 
+def bench_cluster() -> dict:
+    """Disaggregated multi-chip serving: replay a MIXED prefill/decode
+    trace (long-prefix/short-horizon requests interleaved with
+    short-prefix/long-horizon ones — the mix where a long prefill
+    stalls a colocated decode loop) through a 2-shard cluster twice:
+    COLOCATED (shards prefill on their own pool) and DISAGGREGATED
+    (prefill on a dedicated worker, page-granular KV handoff to the
+    owning shard). Both modes run back to back on the same host, so
+    the headline is the environment-normalized ratio of their walls —
+    the ``cluster_decode_latency_ratio`` the perf gate bands; absolute
+    walls ride the raw timings, never gated.
+
+    Each mode runs the trace twice and times the SECOND pass (warm
+    jits — the ratio must compare steady-state scheduling, not
+    compile order). The two modes' streams are checked bitwise
+    identical as a side assertion (the cluster's exactness contract,
+    pinned properly in tests/test_cluster.py), and the capacity lever
+    is measured directly: admitted-before-shed for 1 vs 2 shards on
+    the same per-shard pool.
+
+    Deliberately CPU-sized like the cache/spec scenarios: the claim is
+    about scheduling, routing and the handoff path, so it runs in
+    every bench tier including BENCH_QUICK — the committed
+    bench_e2e.json always carries live transfer counters (the v6
+    ``cluster`` block's non-zero-transfers acceptance gate). Run under
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (``make
+    bench-cluster``, the MULTICHIP harness trick) the shards and the
+    prefill worker land on distinct virtual devices and the handoff is
+    a real cross-device copy; on one device it degrades to a local
+    copy and the counters still tell the truth."""
+    import jax
+    import numpy as np
+
+    from beholder_tpu import metrics as metrics_mod
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+    from beholder_tpu.models.serving import Request
+    from beholder_tpu.obs import FlightRecorder
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    page, slots = 8, 4
+    model = TelemetrySequenceModel(dim=64, heads=4, kv_heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 64, model=model)
+    kw = dict(
+        num_pages=96, page_size=page, slots=slots, max_prefix=64,
+        max_pages_per_seq=24,
+    )
+
+    def mk_request(seed, t, horizon):
+        r = np.random.default_rng(300 + seed)
+        prog = np.cumsum(1.0 + r.normal(0, 0.05, t + 1))
+        stats = np.full(len(prog), int(TelemetryStatusEntry.CONVERTING))
+        return Request(prog, stats, horizon)
+
+    # the mixed trace: 6 prefill-heavy (56-prefix, 8-horizon) requests
+    # interleaved with 10 decode-heavy (8-prefix, 48-horizon) ones
+    trace: list = []
+    heavy = [mk_request(i, 56, 8) for i in range(6)]
+    light = [mk_request(100 + i, 8, 48) for i in range(10)]
+    while heavy or light:
+        if light:
+            trace.append(light.pop(0))
+        if heavy:
+            trace.append(heavy.pop(0))
+        if light:
+            trace.append(light.pop(0))
+    tokens = sum(r.horizon for r in trace)
+
+    registry = metrics_mod.Registry()
+
+    def measure(n_prefill, recorder=None):
+        cluster = ClusterScheduler(
+            model, state.params,
+            ClusterConfig(
+                n_decode_workers=2, n_prefill_workers=n_prefill
+            ),
+            metrics=registry, flight_recorder=recorder, **kw,
+        )
+        cluster.run(trace)  # warm pass: jit compiles
+        t0 = time.perf_counter()
+        results = cluster.run(trace)
+        return results, time.perf_counter() - t0, cluster
+
+    colo_results, colo_s, _ = measure(0)
+    recorder = FlightRecorder(ring_size=4096)
+    disagg_results, disagg_s, disagg = measure(1, recorder=recorder)
+
+    identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(colo_results, disagg_results)
+    )
+
+    # the capacity lever, measured: admitted-before-shed on the same
+    # per-shard pool with 1 vs 2 shards
+    def admitted_before_shed(n_shards):
+        cluster = ClusterScheduler(
+            model, state.params,
+            ClusterConfig(
+                n_decode_workers=n_shards, n_prefill_workers=0,
+                max_pending_per_shard=256,
+            ),
+            metrics=registry, **kw,
+        )
+        n = 0
+        for i in range(512):
+            if not cluster.submit(mk_request(500 + i, 8, 48)).accepted:
+                break
+            n += 1
+        return n
+
+    admit_1 = admitted_before_shed(1)
+    admit_2 = admitted_before_shed(2)
+
+    artifact.record_raw(
+        "serving.cluster_colocated", "trial_wall", [colo_s],
+        tokens=tokens,
+    )
+    artifact.record_raw(
+        "serving.cluster_disaggregated", "trial_wall", [disagg_s],
+        tokens=tokens, transfers=disagg.transfer.transfers,
+        transferred_pages=disagg.transfer.pages,
+    )
+    artifact.record_cluster(registry)
+
+    events = recorder.events()
+    return {
+        "metric": "cluster_decode_latency_ratio",
+        "value": round(disagg_s / colo_s, 4),
+        "colocated_tokens_per_sec": round(tokens / colo_s, 1),
+        "disaggregated_tokens_per_sec": round(tokens / disagg_s, 1),
+        "bitwise_identical_modes": bool(identical),
+        "shards": 2,
+        "devices": jax.device_count(),
+        "transfers": disagg.transfer.transfers,
+        "transferred_pages": disagg.transfer.pages,
+        "transferred_bytes": disagg.transfer.bytes,
+        "admitted_before_shed_1_shard": admit_1,
+        "admitted_before_shed_2_shards": admit_2,
+        "capacity_scaling": (
+            round(admit_2 / admit_1, 2) if admit_1 else 0.0
+        ),
+        "route_events": sum(1 for e in events if e["name"] == "route"),
+        "transfer_events": sum(
+            1 for e in events if e["name"] == "transfer"
+        ),
+        "note": (
+            "16-request mixed trace (6 x 56-prefix/8-horizon + 10 x "
+            "8-prefix/48-horizon) on a 2-shard cluster, colocated vs "
+            "disaggregated (1 prefill worker), second (warm-jit) pass "
+            "timed. value = disaggregated/colocated wall ratio — the "
+            "environment-normalized figure the perf gate bands; "
+            "capacity_scaling = admitted-before-shed going 1 -> 2 "
+            "shards on the same per-shard pool. On CPU the handoff's "
+            "device copies cost more than the prefill overlap saves, "
+            "so ratios near 1 are the healthy baseline; the gate "
+            "catches the handoff path becoming a multiple."
+        ),
+    }
+
+
 def bench_serving_multiwave() -> dict:
     """The workload paging exists for: a request POPULATION (48) much
     bigger than the slot count (8), ragged lengths (40 short
@@ -1651,6 +1812,10 @@ def _e2e_main(rec: artifact.ArtifactRecorder) -> None:
     # CPU-sized for the same reason: the committed artifact always
     # carries a live mean-accept-length for the spec subsystem
     secondary["spec"] = rec.section("spec", bench_spec())
+    # CPU-sized for the same reason again: the committed artifact
+    # always carries live cluster transfer counters (the v6 block's
+    # non-zero-transfers acceptance gate) and the decode-latency ratio
+    secondary["cluster"] = rec.section("cluster", bench_cluster())
     print(
         json.dumps(
             {
@@ -1685,12 +1850,22 @@ def _spec_main(rec: artifact.ArtifactRecorder) -> None:
     print(json.dumps(result))
 
 
+def _cluster_main(rec: artifact.ArtifactRecorder) -> None:
+    """``make bench-cluster``: just the mixed prefill/decode trace on
+    the 2-shard cluster, colocated vs disaggregated (run it under the
+    forced 8-device host-platform mesh for real cross-device
+    handoffs)."""
+    result = rec.section("cluster", bench_cluster())
+    print(json.dumps(result))
+
+
 def main() -> None:
     import sys
 
     accel_only = "--accel-only" in sys.argv
     cache_only = "--cache-only" in sys.argv
     spec_only = "--spec-only" in sys.argv
+    cluster_only = "--cluster-only" in sys.argv
     # EVERY bench run leaves a schema-versioned raw artifact behind —
     # including error and skip outcomes (VERDICT round-5 "What's
     # missing" item 1: perf claims need committed raw files, not prose)
@@ -1698,6 +1873,7 @@ def main() -> None:
         "bench_accel" if accel_only
         else "bench_cache" if cache_only
         else "bench_spec" if spec_only
+        else "bench_cluster" if cluster_only
         else "bench_e2e"
     )
     rec.sections["config"] = {
@@ -1711,6 +1887,8 @@ def main() -> None:
             _cache_main(rec)
         elif spec_only:
             _spec_main(rec)
+        elif cluster_only:
+            _cluster_main(rec)
         else:
             _e2e_main(rec)
     except BaseException as err:
