@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover perf-gate lint clean
 
 all: proto native
 
@@ -64,6 +64,19 @@ bench-cluster:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
 		python bench.py --cluster-only
 
+# the fault-tolerance scenario alone: a decode-heavy trace on a
+# failover-armed 2-shard cluster, uninterrupted vs one decode shard
+# killed mid-stream (all its in-flight requests recover onto the
+# survivor, bitwise), plus a graceful drain of a warm shard and a
+# deadline-expired request — recovery latency and the recovered/
+# uninterrupted decode-wall ratio (writes artifacts/bench_failover.json;
+# the full `make bench` run carries the same scenario inside
+# bench_e2e.json). Same forced-mesh trick as bench-cluster so the
+# drain's page migration is a real cross-device copy.
+bench-failover:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
+		python bench.py --failover-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -78,6 +91,8 @@ perf-gate:
 		--baseline artifacts/bench_spec.json --current artifacts/bench_spec.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_cluster.json --current artifacts/bench_cluster.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_failover.json --current artifacts/bench_failover.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -45,7 +45,9 @@ Schema (``validate`` is the authoritative checker)::
                       "stall_pct": 0.0},  # v5: flight-recorder roofline
       "cluster": {"shards": 0.0, "transfers": 0.0,
                   "transferred_pages": 0.0, "routed": 0.0,
-                  "sheds_by_shard": {}}  # v6: cluster serving
+                  "sheds_by_shard": {}},  # v6: cluster serving
+      "failover": {"recoveries": 0.0, "migrated_pages": 0.0,
+                   "deadline_exceeded": 0.0}  # v7: fault tolerance
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -89,6 +91,16 @@ figure produced on a sharded mesh now says how many chips and how much
 page traffic backed it; the ``make bench-cluster`` acceptance gate
 asserts the committed artifact records NON-ZERO page transfers. v1-v5
 artifacts remain valid.
+
+Schema v7 (the fault-tolerance PR): the run's failover counters ride
+along (:meth:`ArtifactRecorder.record_failover`) — in-flight requests
+recovered onto surviving shards, resident KV pages migrated
+byte-identically by graceful drains, and requests retired with an
+explicit ``deadline_exceeded`` outcome. A headline figure measured
+through a recovery (the ``bench.py --failover-only`` scenario kills a
+live shard mid-trace) now says so; the CI gate asserts the committed
+artifact exercised the recovery path (``recoveries > 0``). v1-v6
+artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -100,7 +112,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -164,6 +176,13 @@ CLUSTER_SHARDS_GAUGE = "beholder_cluster_shards"
 #: v6: per-shard shed attribution (the labelled intake twin); totals
 #: fold by the ``queue`` label into ``sheds_by_shard``
 CLUSTER_SHED_COUNTER = "beholder_intake_shed_total"
+
+#: v7: artifact key -> the failover counter summed into it
+FAILOVER_COUNTERS = {
+    "recoveries": "beholder_failover_recoveries_total",
+    "migrated_pages": "beholder_failover_migrated_pages_total",
+    "deadline_exceeded": "beholder_failover_deadline_exceeded_total",
+}
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
@@ -242,6 +261,9 @@ class ArtifactRecorder:
         }
         self.cluster["shards"] = 0.0
         self.cluster["sheds_by_shard"] = {}
+        self.failover: dict[str, float] = {
+            key: 0.0 for key in FAILOVER_COUNTERS
+        }
 
     def section(
         self,
@@ -367,6 +389,23 @@ class ArtifactRecorder:
                 queue = key[qi]
                 by_shard[queue] = by_shard.get(queue, 0.0) + float(value)
 
+    def record_failover(self, registry) -> None:
+        """Accumulate one registry's failover counters (requests
+        recovered onto surviving shards, pages migrated by graceful
+        drains, deadline-exceeded retirements). Same
+        accumulate-across-registries contract as
+        :meth:`record_reliability`."""
+        find = getattr(registry, "find", None)
+        if find is None:  # a Metrics wrapper
+            registry = getattr(registry, "registry", None)
+            find = getattr(registry, "find", None)
+            if find is None:
+                return
+        for key, name in FAILOVER_COUNTERS.items():
+            counter = find(name)
+            if counter is not None:
+                self.failover[key] += float(counter.total())
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -410,6 +449,7 @@ class ArtifactRecorder:
             },
             "attribution": copy.deepcopy(self.attribution),
             "cluster": copy.deepcopy(self.cluster),
+            "failover": dict(self.failover),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -484,6 +524,14 @@ def record_cluster(registry) -> None:
     as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_cluster(registry)
+
+
+def record_failover(registry) -> None:
+    """Accumulate a registry's failover counters into the active
+    recorder's v7 ``failover`` block; no-op without one (same contract
+    as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_failover(registry)
 
 
 # -- validation ---------------------------------------------------------------
@@ -600,6 +648,18 @@ def validate(obj: Any) -> None:
                     "cluster.sheds_by_shard must be a dict of numbers, "
                     f"got {sheds!r}"
                 )
+    if isinstance(version, int) and version >= 7:
+        # v7: fault-tolerance counters are part of the evidence
+        failover = obj.get("failover")
+        if not isinstance(failover, dict):
+            problems.append("failover must be a dict (schema v7+)")
+        else:
+            for key in FAILOVER_COUNTERS:
+                if not isinstance(failover.get(key), (int, float)):
+                    problems.append(
+                        f"failover.{key} must be a number, "
+                        f"got {failover.get(key)!r}"
+                    )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):
         problems.append("raw_timings must be a list")

@@ -224,6 +224,57 @@ class PrefixCache:
             self._metrics.cached_pages.set(len(self._entries))
         return new_pages, new_keys
 
+    # -- migration (cluster drain) -------------------------------------------
+    def export_entries(self) -> list[tuple[bytes, bytes | None, int, int]]:
+        """Every entry as ``(key, parent, page_id, live_users)``,
+        PARENT-FIRST — the drain-migration unit
+        (:func:`beholder_tpu.cluster.failover.migrate_pool`). The
+        ordering guarantees :meth:`adopt_entry` never sees a child
+        before its ancestor, so the adopted index keeps the invariant
+        that every key's full chain is present."""
+        emitted: set[bytes | None] = {None}
+        out: list[tuple[bytes, bytes | None, int, int]] = []
+        remaining = dict(self._entries)
+        while remaining:
+            progressed = False
+            for key in list(remaining):
+                entry = remaining[key]
+                parent = entry.parent
+                # a parent outside the index (evicted root marker or
+                # b"root" chains use parent=None) counts as emitted
+                if parent in emitted or parent not in self._entries:
+                    out.append(
+                        (key, parent, entry.page_id, entry.live_users)
+                    )
+                    emitted.add(key)
+                    del remaining[key]
+                    progressed = True
+            if not progressed:  # pragma: no cover - defensive
+                raise RuntimeError("prefix-cache index has a parent cycle")
+        return out
+
+    def adopt_entry(
+        self, key: bytes, parent: bytes | None, page_id: int,
+        live_users: int = 0,
+    ) -> bool:
+        """Adopt one migrated entry (drain): same collision rule as
+        :meth:`insert` — a key already cached here keeps ITS page
+        (returns False; the caller must drop the cache reference on
+        the duplicate migrated page), otherwise the entry lands with
+        its pins (``live_users``) intact and the caller's ONE device
+        reference already rides the migrated refcount."""
+        if key in self._entries:
+            return False
+        entry = self._entries[key] = _PageEntry(key, parent, page_id)
+        entry.live_users = int(live_users)
+        self._stamp += 1
+        entry.stamp = self._stamp
+        if parent is not None and parent in self._entries:
+            self._entries[parent].children += 1
+        if self._metrics is not None:
+            self._metrics.cached_pages.set(len(self._entries))
+        return True
+
     def prefilled(self, n_tokens: int) -> None:
         """Record tokens actually run through the prefill forward."""
         self.prefill_tokens += int(n_tokens)

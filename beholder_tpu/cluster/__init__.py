@@ -30,6 +30,13 @@ submit/run API, same bitwise outputs, N× the pool:
   attribution, rebalance queued work across shards at drain time,
   and a per-shard serving loop that claims via the same invariants as
   ``ContinuousBatcher._claim_admissions``.
+- **Fault tolerance** (:mod:`.failover`, ``instance.cluster.
+  failover.*`` — default OFF, under which the cluster stays
+  fail-stop): worker heartbeats + chaos-injectable failure detection,
+  in-flight request recovery onto surviving shards (exact-greedy
+  recovered streams bitwise-identical to an uninterrupted run),
+  graceful drain with byte-identical live-page + prefix-pin
+  migration, and deadline-aware retirement.
 
 **Exactness.** Under exact greedy the cluster emits token streams
 bitwise-identical to the single-device engine on the same request
@@ -59,6 +66,49 @@ ROUTE_ROUND_ROBIN = "round_robin"
 
 
 @dataclass
+class FailoverConfig:
+    """Fault-tolerance knobs (``instance.cluster.failover.*``).
+
+    None on :class:`ClusterConfig` (the default) means fail-stop: a
+    worker failure raises, exactly the pre-failover cluster. Set, the
+    router arms a :class:`~beholder_tpu.cluster.failover.
+    FailoverEngine`: per-worker heartbeats + failure detection,
+    in-flight request recovery onto surviving shards, graceful drain,
+    and deadline-aware retirement — all invisible to callers (recovered
+    exact-greedy streams stay bitwise-identical to an uninterrupted
+    run; pinned by ``tests/test_cluster_chaos.py``)."""
+
+    #: heartbeat staleness unit: a watched worker whose last beat is
+    #: older than ``heartbeat_interval_s * miss_threshold`` is marked
+    #: down (hang detection)
+    heartbeat_interval_s: float = 5.0
+    miss_threshold: int = 3
+    #: recovery cap per request: a request re-admitted more times than
+    #: this (pathological cascades) resolves to an explicit ``Dropped``
+    #: outcome instead of looping forever
+    max_recoveries_per_request: int = 2
+    #: service shutdown (SIGTERM routes to close()): drain every shard
+    #: — stop admitting, serve what's queued — before exiting
+    drain_on_sigterm: bool = True
+
+    def __post_init__(self):
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s must be > 0, "
+                f"got {self.heartbeat_interval_s}"
+            )
+        if self.miss_threshold < 1:
+            raise ValueError(
+                f"miss_threshold must be >= 1, got {self.miss_threshold}"
+            )
+        if self.max_recoveries_per_request < 0:
+            raise ValueError(
+                f"max_recoveries_per_request must be >= 0, "
+                f"got {self.max_recoveries_per_request}"
+            )
+
+
+@dataclass
 class ClusterConfig:
     """Cluster-serving knobs (``instance.cluster.*``).
 
@@ -77,6 +127,8 @@ class ClusterConfig:
     #: can ever hold)
     max_pending_per_shard: int = 16
     max_pending_pages_per_shard: int | None = None
+    #: fault tolerance: None (the default) keeps the fail-stop cluster
+    failover: FailoverConfig | None = None
 
     def __post_init__(self):
         if self.n_decode_workers < 1:
@@ -108,6 +160,21 @@ def cluster_from_config(config) -> ClusterConfig | None:
     if not bool(config.get("instance.cluster.enabled")):
         return None
     max_pages = config.get("instance.cluster.max_pending_pages_per_shard")
+    failover = None
+    if bool(config.get("instance.cluster.failover.enabled")):
+        fo = "instance.cluster.failover"
+        failover = FailoverConfig(
+            heartbeat_interval_s=float(
+                config.get(f"{fo}.heartbeat_interval_s", 5.0)
+            ),
+            miss_threshold=int(config.get(f"{fo}.miss_threshold", 3)),
+            max_recoveries_per_request=int(
+                config.get(f"{fo}.max_recoveries_per_request", 2)
+            ),
+            drain_on_sigterm=bool(
+                config.get(f"{fo}.drain_on_sigterm", True)
+            ),
+        )
     return ClusterConfig(
         n_decode_workers=int(
             config.get("instance.cluster.n_decode_workers", 2)
@@ -124,11 +191,13 @@ def cluster_from_config(config) -> ClusterConfig | None:
         max_pending_pages_per_shard=(
             int(max_pages) if max_pages is not None else None
         ),
+        failover=failover,
     )
 
 
 __all__ = [
     "ClusterConfig",
+    "FailoverConfig",
     "ROUTE_PRESSURE",
     "ROUTE_ROUND_ROBIN",
     "cluster_from_config",

@@ -22,6 +22,8 @@ Catalog (all appear only when a cluster scheduler gets a registry):
   pages moved by those handoffs
 - ``beholder_cluster_transferred_bytes_total`` — counter: live KV
   bytes moved (page bytes x layers x k+v, at the transfer dtype)
+- ``beholder_cluster_transfer_failed_total`` — counter: transfers
+  that failed terminally (bounded retry exhausted)
 - ``beholder_cluster_routes_total{reason}`` — counter: routing
   decisions by reason (``pressure`` / ``round_robin`` / ``only_shard``
   / ``rebalance``)
@@ -80,6 +82,12 @@ class ClusterMetrics:
             "beholder_cluster_transferred_bytes_total",
             "Live KV bytes moved by prefill->decode handoffs",
         )
+        self.transfer_failed_total = get_or_create(
+            registry, "counter",
+            "beholder_cluster_transfer_failed_total",
+            "Page transfers that failed terminally (bounded retry "
+            "exhausted; surfaced to the router as TransferFailed)",
+        )
         self.routes_total = get_or_create(
             registry, "counter",
             "beholder_cluster_routes_total",
@@ -102,3 +110,75 @@ class ClusterMetrics:
     def set_shard_pool(self, shard: str, free: int, committed: int) -> None:
         self.pool_pages_free.set(free, shard=shard)
         self.pool_pages_committed.set(committed, shard=shard)
+
+
+class FailoverMetrics:
+    """The ``beholder_failover_*`` catalog, registered only when a
+    failover-armed cluster scheduler gets a registry (same on-demand
+    contract as every other subsystem catalog — default exposition
+    stays byte-identical):
+
+    - ``beholder_failover_worker_up{worker}`` — gauge: 1 while a
+      decode shard / prefill worker routes traffic, 0 once down or
+      drained
+    - ``beholder_failover_worker_failures_total{worker, kind}`` —
+      counter: detected worker failures (``kill`` / ``hang`` /
+      ``transfer_failed``)
+    - ``beholder_failover_recoveries_total{reason}`` — counter:
+      in-flight requests re-admitted on surviving shards
+    - ``beholder_failover_dropped_total{reason}`` — counter: requests
+      resolved to an explicit Dropped outcome (``shard_down`` /
+      ``recovery_limit``)
+    - ``beholder_failover_drains_total`` — counter: graceful shard
+      decommissions completed
+    - ``beholder_failover_migrated_pages_total`` — counter: resident
+      KV pages moved byte-identically by drains
+    - ``beholder_failover_deadline_exceeded_total`` — counter:
+      requests retired with an expired deadline (the serving layer
+      registers the same series lazily on first expiry)
+    """
+
+    def __init__(self, registry):
+        registry = getattr(registry, "registry", registry)
+        self.registry = registry
+        self.worker_up = get_or_create(
+            registry, "gauge",
+            "beholder_failover_worker_up",
+            "1 while the worker routes traffic, 0 once down or drained",
+            labelnames=["worker"],
+        )
+        self.worker_failures_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_worker_failures_total",
+            "Detected worker failures by worker and kind",
+            labelnames=["worker", "kind"],
+        )
+        self.recoveries_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_recoveries_total",
+            "In-flight requests recovered onto surviving shards, by "
+            "failure reason",
+            labelnames=["reason"],
+        )
+        self.dropped_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_dropped_total",
+            "Requests resolved to an explicit Dropped outcome, by reason",
+            labelnames=["reason"],
+        )
+        self.drains_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_drains_total",
+            "Graceful shard decommissions completed",
+        )
+        self.migrated_pages_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_migrated_pages_total",
+            "Resident KV pages migrated byte-identically by drains",
+        )
+        self.deadline_exceeded_total = get_or_create(
+            registry, "counter",
+            "beholder_failover_deadline_exceeded_total",
+            "Requests retired with an expired deadline (explicit "
+            "deadline_exceeded outcome instead of a wedged slot)",
+        )

@@ -79,10 +79,17 @@ class ShardedPoolView:
     def total_free(self) -> int:
         return sum(s.free for s in self.shards)
 
-    def least_pressure(self) -> ShardPool:
-        """The shard with the most free pages; ties break to the
-        lowest shard id so a replayed stream routes identically."""
-        return max(self.shards, key=lambda s: (s.free, -s.shard_id))
+    def least_pressure(self, pools: list[ShardPool] | None = None) -> ShardPool:
+        """The shard with the most free pages — over every shard, or
+        the ``pools`` subset (the failover router routes over UP
+        shards only; drain targets survivors). Ties break to the
+        lowest shard id so a replayed stream routes identically —
+        every caller MUST come through here so the tie-break can never
+        silently diverge between routing and drain."""
+        return max(
+            self.shards if pools is None else pools,
+            key=lambda s: (s.free, -s.shard_id),
+        )
 
     def refresh_gauges(self, instruments) -> None:
         """Export every shard's free/committed pages on the labelled

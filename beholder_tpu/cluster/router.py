@@ -51,6 +51,11 @@ import time
 import numpy as np
 
 from . import ROUTE_ROUND_ROBIN, ClusterConfig
+from .failover import (
+    WORKER_UP,
+    FailoverEngine,
+    NoHealthyShards,
+)
 from .pool import ShardedPoolView, ShardPool, place_paged_state
 from .transfer import PageTransferEngine, PrefillWorker
 
@@ -62,6 +67,9 @@ class _Shard:
         self.pool = pool
         self.batcher = batcher
         self.intake = intake
+        #: lazily built colocated-fallback prefill worker (failover:
+        #: every dedicated prefill worker down ⇒ prefill on the shard)
+        self.local_prefill = None
 
 
 class ClusterScheduler:
@@ -165,10 +173,31 @@ class ClusterScheduler:
             )
             for j in range(cluster.n_prefill_workers)
         ]
+        # the device hop is always retried (transient fabric faults
+        # absorb; persistent ones surface as a typed TransferFailed)
+        from beholder_tpu.reliability.policy import RetryPolicy
+
         self.transfer = PageTransferEngine(
             instruments=self.instruments,
             flight_recorder=flight_recorder,
+            retry=RetryPolicy(
+                max_attempts=3, base_delay_s=0.005, max_delay_s=0.05
+            ),
         )
+        #: fault tolerance (None = the fail-stop cluster, byte-identical
+        #: to pre-failover behavior)
+        self.failover = (
+            FailoverEngine(
+                self, cluster.failover,
+                registry=self._registry,
+                flight_recorder=flight_recorder,
+            )
+            if cluster.failover is not None
+            else None
+        )
+        #: admission-order results decided outside a serve (drain-time
+        #: shard_down drops), merged by run_pending
+        self._pending_drops: dict[int, object] = {}
         self._rr = 0
         self._pf_rr = 0
         #: monotone submit sequence — the admission-order key
@@ -184,11 +213,107 @@ class ClusterScheduler:
     def disaggregated(self) -> bool:
         return bool(self.prefill_workers)
 
+    def health_snapshot(self) -> dict:
+        """Per-worker health for the ``/healthz`` ``cluster`` check:
+        every decode shard's state (up/draining/down) + pool pressure,
+        every prefill worker's state, and the down/draining rollups.
+        Without failover every worker reports up (the fail-stop
+        cluster has no other answer)."""
+        fo = self.failover
+        workers: dict[str, dict] = {}
+        for shard in self.shards:
+            workers[shard.pool.name] = {
+                "state": fo.state(shard.pool.name) if fo else WORKER_UP,
+                "free_pages": shard.pool.free,
+                "committed_pages": shard.pool.committed,
+            }
+        for worker in self.prefill_workers:
+            workers[worker.name] = {
+                "state": fo.state(worker.name) if fo else WORKER_UP,
+            }
+        return {
+            "workers": workers,
+            # only FAILED workers roll up into "down" (the health
+            # check's degradation trigger); a drained shard completed
+            # a planned decommission — reported, never sick
+            "down": sorted(
+                n for n, w in workers.items() if w["state"] == "down"
+            ),
+            "draining": sorted(
+                n for n, w in workers.items() if w["state"] == "draining"
+            ),
+            "drained": sorted(
+                n for n, w in workers.items() if w["state"] == "drained"
+            ),
+        }
+
+    def drain(self, shard_id: int) -> dict:
+        """Gracefully decommission decode shard ``shard_id`` (requires
+        failover): queued work migrates to surviving intakes, resident
+        pool state — live slots and warm prefix-cache pages — moves
+        byte-identically through the transfer engine, and the shard
+        leaves the cluster with zero loss. See
+        :meth:`~beholder_tpu.cluster.failover.FailoverEngine.drain`."""
+        if self.failover is None:
+            raise RuntimeError(
+                "drain requires instance.cluster.failover — the "
+                "fail-stop cluster has no migration machinery"
+            )
+        return self.failover.drain(shard_id)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Planned full-cluster shutdown (the SIGTERM path when
+        ``failover.drain_on_sigterm``): every shard stops admitting
+        FIRST (``draining`` — a submit racing the shutdown sheds
+        ``shard_down`` instead of being silently lost at exit), then
+        queued work is served to completion, so a decommission loses
+        nothing. ``drain=False`` skips the final serve (fast
+        shutdown)."""
+        fo = self.failover
+        if fo is not None:
+            from .failover import WORKER_DRAINING
+
+            for shard in self.shards:
+                if fo.state(shard.pool.name) == WORKER_UP:
+                    fo._set_state(shard.pool.name, WORKER_DRAINING)
+        if drain and any(s.intake.depth for s in self.shards):
+            if fo is not None:
+                # draining shards still SERVE during the final drain —
+                # they only stopped admitting
+                fo._drain_serving = True
+                try:
+                    self.run_pending()
+                finally:
+                    fo._drain_serving = False
+            else:
+                self.run_pending()
+
     # -- routing ---------------------------------------------------------
 
     def _need(self, request) -> int:
         # shards share geometry, so any batcher's arithmetic serves
         return self.shards[0].batcher._need_pages(request)
+
+    @staticmethod
+    def _fits(shard: _Shard, need: int) -> bool:
+        """Whether a worst-case ``need`` can EVER run on this shard
+        (pool bound + per-seq table cap — the submit rule)."""
+        return (
+            need <= shard.batcher.num_pages
+            and need <= shard.batcher.max_pages_per_seq
+        )
+
+    def _routable(self) -> list[_Shard]:
+        """Shards admissions may route to: all of them fail-stop, the
+        UP subset under failover (down/draining shards leave the set)."""
+        if self.failover is None:
+            return self.shards
+        routable = self.failover.routable_shards()
+        if not routable:
+            raise NoHealthyShards(
+                "every decode shard is down — nothing can serve"
+            )
+        return routable
 
     def _record_route(self, shard: _Shard, reason: str, need: int,
                       dur_s: float, ts_s: float) -> None:
@@ -202,17 +327,22 @@ class ClusterScheduler:
 
     def _route(self, need: int) -> _Shard:
         """Pick the shard for one request of worst-case ``need`` pages
-        and record the decision (counter + recorder-only event)."""
+        and record the decision (counter + recorder-only event). Under
+        failover only UP shards are candidates — a down/draining shard
+        is invisible to routing."""
         ts = time.time()
         t0 = time.perf_counter()
-        if len(self.shards) == 1:
-            shard, reason = self.shards[0], "only_shard"
+        candidates = self._routable()
+        if len(candidates) == 1:
+            shard, reason = candidates[0], "only_shard"
         elif self.cluster.route_policy == ROUTE_ROUND_ROBIN:
-            shard = self.shards[self._rr % len(self.shards)]
+            shard = candidates[self._rr % len(candidates)]
             self._rr += 1
             reason = "round_robin"
         else:
-            target = self.pool_view.least_pressure()
+            target = self.pool_view.least_pressure(
+                [s.pool for s in candidates]
+            )
             shard = self.shards[target.shard_id]
             reason = "pressure"
         self._record_route(
@@ -227,6 +357,45 @@ class ClusterScheduler:
         self._pf_rr += 1
         return worker
 
+    def _prefill_with_failover(self, shard: _Shard, feats_np, t: int):
+        """One request's prefill on a healthy prefill worker, failing
+        over: a typed worker death marks the worker down and the next
+        survivor takes the request; with every dedicated worker down
+        the shard prefills COLOCATED on its own device (a lazily built
+        local fallback). Failover degrades PLACEMENT, never
+        correctness — the chunks are bitwise the same wherever the
+        forward ran. Returns ``(worker, (pred, ck, cv, n_pages))``."""
+        from .failover import WorkerKilled
+
+        fo = self.failover
+        if fo is None:
+            worker = self._next_prefill_worker()
+            return worker, worker.prefill(feats_np, t)
+        while True:
+            candidates = fo.up_prefill_workers()
+            if not candidates:
+                break
+            worker = candidates[self._pf_rr % len(candidates)]
+            self._pf_rr += 1
+            try:
+                out = worker.prefill(feats_np, t)
+            except WorkerKilled as err:
+                fo.mark_down(worker.name, err.kind)
+                continue
+            fo.heartbeat(worker.name)
+            return worker, out
+        if shard.local_prefill is None:
+            shard.local_prefill = PrefillWorker(
+                self.model,
+                shard.batcher.params,
+                shard.batcher.page_size,
+                device=shard.pool.device,
+                name=shard.pool.name,
+            )
+        return shard.local_prefill, shard.local_prefill.prefill(
+            feats_np, t
+        )
+
     # -- the batcher-shaped API ------------------------------------------
 
     def run(self, requests: list) -> list[np.ndarray]:
@@ -235,41 +404,150 @@ class ClusterScheduler:
         returns, in the SAME order — routing is invisible to callers.
         Under exact greedy the streams are bitwise-identical to one
         :meth:`~beholder_tpu.models.serving.ContinuousBatcher.run` over
-        the same stream (pinned by ``tests/test_cluster.py``)."""
-        results: list = [None] * len(requests)
-        assignments: dict[int, list[tuple[int, object, int]]] = {
-            s.pool.shard_id: [] for s in self.shards
-        }
-        for gid, req in enumerate(requests):
-            need = self._need(req)
-            shard = self._route(need)
-            shard.pool.reserve(need)
-            assignments[shard.pool.shard_id].append((gid, req, need))
+        the same stream (pinned by ``tests/test_cluster.py``) — and,
+        with failover armed, that identity survives a shard dying
+        mid-stream (pinned by ``tests/test_cluster_chaos.py``)."""
+        out = self._serve_pairs(list(enumerate(requests)))
+        return [out[gid] for gid in range(len(requests))]
+
+    def _serve_pairs(self, pairs: list) -> dict:
+        """Route + serve ``(key, request)`` pairs; returns
+        ``{key: result}``. Fail-stop (no failover) this is one pass —
+        route everything, serve shard by shard, exceptions propagate —
+        byte-identical to the pre-failover router. With failover armed
+        it is the RECOVERY loop: a typed worker failure
+        (:data:`~beholder_tpu.cluster.failover.FailoverEngine.
+        RECOVERABLE`) marks the shard down and its whole batch
+        re-routes to surviving shards on the next pass, where the
+        deterministic exact-greedy replay re-prefills from host-side
+        request state (observed history; surviving shards' prefix
+        caches serve warm hits) and :meth:`FailoverEngine.splice`
+        joins it onto anything an incremental embedder already
+        delivered (``record_emitted``) — no token index emitted twice
+        or skipped; the synchronous whole-stream case splices an
+        empty prefix. A
+        request recovered more than ``max_recoveries_per_request``
+        times, or one no surviving shard can ever hold, resolves to an
+        explicit :class:`~beholder_tpu.cluster.failover.Dropped`
+        outcome (``recovery_limit`` / ``shard_down``)."""
+        from beholder_tpu.reliability.shed import SHED_SHARD_DOWN
+
+        fo = self.failover
+        out: dict = {}
+        pending = list(pairs)
+        attempts: dict = {}
+        pass_index = 0
+        while pending:
+            if fo is not None:
+                fo.sweep()
+            t_pass = time.perf_counter()
+            assignments: dict[int, list] = {
+                s.pool.shard_id: [] for s in self.shards
+            }
+            for key, req in pending:
+                need = self._need(req)
+                if fo is not None:
+                    routable = fo.routable_shards()
+                    if (
+                        not routable
+                        or not any(self._fits(s, need) for s in routable)
+                    ) and any(self._fits(s, need) for s in self.shards):
+                        # servable on the full cluster, not on what's
+                        # left (or nothing is left): explicit outcome.
+                        # A request NO shard could ever hold falls
+                        # through to the batcher's own oversized error
+                        # — that is a caller bug, not a shard failure
+                        out[key] = fo.drop(SHED_SHARD_DOWN)
+                        continue
+                shard = self._route(need)
+                shard.pool.reserve(need)
+                assignments[shard.pool.shard_id].append((key, req, need))
+            pending = []
+            self.pool_view.refresh_gauges(self.instruments)
+            for shard in self.shards:
+                items = assignments[shard.pool.shard_id]
+                if not items:
+                    continue
+                if fo is not None:
+                    fo.begin_serve(shard.pool.name)
+                try:
+                    served = self._serve(
+                        shard, [req for _, req, _ in items]
+                    )
+                except Exception as err:
+                    if fo is None or not isinstance(
+                        err, fo.RECOVERABLE
+                    ):
+                        raise
+                    # the shard is gone: release its reservations, mark
+                    # it down, and re-admit the batch on survivors
+                    for _, _, need in items:
+                        shard.pool.release(need)
+                    kind = fo.on_shard_failure(shard, err)
+                    retried = 0
+                    for key, req, _ in items:
+                        attempts[key] = attempts.get(key, 0) + 1
+                        if (
+                            attempts[key]
+                            > fo.config.max_recoveries_per_request
+                        ):
+                            out[key] = fo.drop("recovery_limit")
+                        else:
+                            pending.append((key, req))
+                            retried += 1
+                    fo.count_recovered(shard.pool.name, kind, retried)
+                    continue
+                finally:
+                    if fo is not None:
+                        fo.end_serve(shard.pool.name)
+                # reservations come off FIRST: the serve is done, so
+                # they are spent regardless of how splicing goes (a
+                # splice refusal must not strand committed pages)
+                for _, _, need in items:
+                    shard.pool.release(need)
+                for (key, _, _), res in zip(items, served):
+                    if fo is not None and isinstance(res, np.ndarray):
+                        res = fo.splice(key, res)
+                    out[key] = res
+                if self.instruments is not None:
+                    self.instruments.requests_total.inc(
+                        len(items), shard=str(shard.pool.shard_id)
+                    )
+            if fo is not None and pass_index > 0:
+                fo.recovery_walls.append(time.perf_counter() - t_pass)
+            pass_index += 1
+        if fo is not None:
+            # ledger hygiene: keys recur across run() calls, so
+            # entries for terminal outcomes (splice already consumed
+            # the rest) must not survive into the next call
+            fo.discard_emitted(list(out))
         self.pool_view.refresh_gauges(self.instruments)
-        for shard in self.shards:
-            items = assignments[shard.pool.shard_id]
-            if not items:
-                continue
-            served = self._serve(shard, [req for _, req, _ in items])
-            for (gid, _, need), res in zip(items, served):
-                results[gid] = res
-                shard.pool.release(need)
-            if self.instruments is not None:
-                self.instruments.requests_total.inc(
-                    len(items), shard=str(shard.pool.shard_id)
-                )
-        self.pool_view.refresh_gauges(self.instruments)
-        return results
+        return out
 
     def submit(self, request):
         """Offer one request to the cluster: route, then the owning
         shard's bounded intake decides — an explicit
         :class:`~beholder_tpu.reliability.shed.Admission`, with sheds
         attributed to the shard's queue
-        (``beholder_intake_shed_total{queue, reason}``)."""
-        from beholder_tpu.reliability.shed import SHED_OVERSIZED
+        (``beholder_intake_shed_total{queue, reason}``). With failover
+        armed, routing sees only UP shards; a request the full cluster
+        could hold but the survivors cannot sheds ``shard_down``."""
+        from beholder_tpu.reliability.shed import (
+            SHED_OVERSIZED,
+            SHED_SHARD_DOWN,
+        )
 
+        fo = self.failover
         need = self._need(request)
+        if fo is not None:
+            fo.sweep()
+            if not any(self._fits(s, need) for s in fo.routable_shards()):
+                reason = (
+                    SHED_SHARD_DOWN
+                    if any(self._fits(s, need) for s in self.shards)
+                    else SHED_OVERSIZED
+                )
+                return fo.shed(reason)
         shard = self._route(need)
         batcher = shard.batcher
         if need > batcher.num_pages or need > batcher.max_pages_per_seq:
@@ -288,7 +566,17 @@ class ClusterScheduler:
         'rebalance on horizon' step), then drain and serve every
         shard. Results come back in ADMISSION order across the whole
         cluster — the single-engine ``run_pending`` contract; routing
-        and rebalance stay invisible to callers."""
+        and rebalance stay invisible to callers.
+
+        With failover armed the drain re-routes everything through the
+        recovery-aware loop instead (a queued item's submit-time shard
+        may have died since): queued work on a down shard migrates to
+        survivors, failures mid-serve recover, and items nothing can
+        hold (plus drain-time ``shard_down`` drops) resolve to
+        explicit :class:`~beholder_tpu.cluster.failover.Dropped`
+        outcomes in their admission-order positions."""
+        if self.failover is not None:
+            return self._run_pending_failover()
         self._rebalance()
         collected: list[tuple[int, np.ndarray]] = []
         for shard in self.shards:
@@ -309,6 +597,24 @@ class ClusterScheduler:
         self.pool_view.refresh_gauges(self.instruments)
         collected.sort(key=lambda pair: pair[0])
         return [result for _, result in collected]
+
+    def _run_pending_failover(self) -> list:
+        """The failover drain: pull every shard's queue (down shards'
+        included — their queued work must not die with them), release
+        the submit-time reservations, and push everything through the
+        recovery-aware ``_serve_pairs`` in admission order."""
+        self.failover.sweep()
+        pairs: list[tuple[int, object]] = []
+        for shard in self.shards:
+            pending = shard.intake.take_all()
+            for seq, req in pending:
+                shard.pool.release(self._need(req))
+                pairs.append((seq, req))
+        drops, self._pending_drops = self._pending_drops, {}
+        pairs.sort(key=lambda pair: pair[0])
+        out = self._serve_pairs(pairs)
+        out.update(drops)
+        return [out[seq] for seq in sorted(out)]
 
     def _serve(self, shard: _Shard, requests: list) -> list[np.ndarray]:
         batcher = shard.batcher
@@ -405,6 +711,7 @@ class ClusterScheduler:
         import jax.numpy as jnp
 
         from beholder_tpu.models.serving import (
+            DeadlineExceededResult,
             _adopt_chunks_carry,
             _RunCarry,
         )
@@ -430,20 +737,26 @@ class ClusterScheduler:
         def free_pages() -> int:
             return b.num_pages - int(total_need.sum())
 
+        deadline_rids: list[int] = []
+        has_deadlines = any(
+            getattr(r, "deadline", None) is not None for r in requests
+        )
+
         # retire_many and the packed readback below deliberately mirror
         # _run()'s — folding all three serving loops into one composable
         # step pipeline is ROADMAP open item 2; until then a change to
         # _run's snapshot/readback packing must be mirrored here (the
         # bitwise-identity test fails loudly if they drift)
-        def retire_many(done: list[int]):
+        def retire_many(done: list[int], expired: bool = False):
             with b._round(span, "retire", slots=len(done)):
                 idx = jnp.asarray(done, jnp.int32)
                 rids = [req_of[s] for s in done]
+                widths = [int(written[s]) for s in done]
                 snap_batches.append((
                     rids,
                     carry.delta_buf[idx],
                     carry.last_pred[idx],
-                    [int(written[s]) for s in done],
+                    widths,
                 ))
                 b.state = b._release_many(b.state, idx)
                 for s in done:
@@ -451,9 +764,32 @@ class ClusterScheduler:
                     total_need[s] = 0
                     written[s] = 0
                 served[0] += len(done)
-                served[1] += sum(requests[r].horizon for r in rids)
+                if expired:
+                    served[1] += sum(w + 1 for w in widths)
+                    deadline_rids.extend(rids)
+                    b._count_deadline_exceeded(len(done))
+                    if fr is not None:
+                        fr.instant(
+                            "deadline_exceeded", stage="tick",
+                            worker=shard.pool.name, slots=len(done),
+                        )
+                else:
+                    served[1] += sum(requests[r].horizon for r in rids)
 
         while queue or any(r is not None for r in req_of):
+            if self.failover is not None:
+                self.failover.heartbeat(shard.pool.name)
+            if has_deadlines:
+                # the deadline sweep at the scheduling-event boundary
+                # (mirrors _run — an expired request must not wedge a
+                # slot through a recovery storm)
+                lapsed = [
+                    s for s in range(b.slots)
+                    if req_of[s] is not None
+                    and b._deadline_expired(requests[req_of[s]])
+                ]
+                if lapsed:
+                    retire_many(lapsed, expired=True)
             # claim round: ONE copy of the hardening invariants
             # (headroom arithmetic, pressure deferral + stall marker,
             # exhaustion fail-fast, recorder-only claim event) — the
@@ -472,12 +808,13 @@ class ClusterScheduler:
             for slot, rid, feats_np, t, _hit, _hashes in batch:
                 # prefill on a dedicated worker (recorder-only event,
                 # flash-family kernel tags — the prefill FLOPs moved
-                # OFF this shard is exactly what the timeline shows)
-                worker = self._next_prefill_worker()
+                # OFF this shard is exactly what the timeline shows);
+                # under failover a dead worker's request fails over to
+                # the next survivor (or the shard's colocated fallback)
                 pf_ts = time.time() if fr is not None else 0.0
                 pf_t0 = time.perf_counter()
-                pred, chunks_k, chunks_v, n_pages = worker.prefill(
-                    feats_np, t
+                worker, (pred, chunks_k, chunks_v, n_pages) = (
+                    self._prefill_with_failover(shard, feats_np, t)
                 )
                 if fr is not None:
                     fr.record(
@@ -586,6 +923,8 @@ class ClusterScheduler:
             rows_v = got[1 + r :].reshape(r, cap)
             for i, (rid, w) in enumerate(zip(rids, widths)):
                 results[rid] = np.append(rows_v[i, :w], tails_v[i])
+            for rid in deadline_rids:
+                results[rid] = DeadlineExceededResult(results[rid])
         elif bool(jax.device_get(b.state.alloc_failed)):
             raise RuntimeError(b._ALLOCATOR_TRIPPED)
         if b._metrics:

@@ -32,6 +32,23 @@ from __future__ import annotations
 import time
 
 
+class TransferFailed(RuntimeError):
+    """A page transfer failed TERMINALLY (the bounded retry inside
+    :class:`PageTransferEngine` was exhausted). Typed so the cluster
+    router can treat it as a worker-level fault — mark the destination
+    unreachable and recover the request on a surviving shard — instead
+    of an anonymous exception raising through the tick loop."""
+
+    def __init__(self, src: str, dst: str, cause: BaseException):
+        super().__init__(
+            f"page transfer {src} -> {dst} failed after retries: "
+            f"{cause!r}"
+        )
+        self.src = src
+        self.dst = dst
+        self.kind = "transfer_failed"
+
+
 class PrefillWorker:
     """A dedicated prefill worker: the model forward on its own mesh
     device, producing handoff chunks instead of pool writes.
@@ -92,14 +109,86 @@ class PageTransferEngine:
     can read them directly), and records a recorder-only ``transfer``
     phase event per handoff with the (src, dst) worker pair — the
     timeline shows WHICH workers the pages crossed between, one track
-    per worker in the Chrome trace export."""
+    per worker in the Chrome trace export.
 
-    def __init__(self, instruments=None, flight_recorder=None):
+    ``retry`` (a :class:`~beholder_tpu.reliability.policy.RetryPolicy`)
+    bounds the ``device_put`` hop: a transient fabric fault retries
+    with jittered backoff, a persistent one surfaces as a typed
+    :class:`TransferFailed` (counted on ``failed`` and
+    ``beholder_cluster_transfer_failed_total``) for the router to act
+    on — never an anonymous exception out of the tick loop.
+    ``fail_next`` is the deterministic chaos hook (the
+    ``transfer_corruption`` leg of
+    :class:`~beholder_tpu.reliability.chaos.WorkerFault`)."""
+
+    def __init__(self, instruments=None, flight_recorder=None, retry=None):
         self.instruments = instruments
         self.flight_recorder = flight_recorder
+        self.retry = retry
         self.transfers = 0
         self.pages = 0
         self.bytes = 0
+        #: terminal transfer failures (retries exhausted)
+        self.failed = 0
+        #: chaos injections observed
+        self.faults_injected = 0
+        self._fail_next = 0
+        self._fail_exc: Exception | None = None
+        self._fail_worker: str | None = None
+
+    # -- fault injection + the retried device hop ------------------------
+
+    def fail_next(
+        self, n: int, exc: Exception | None = None,
+        worker: str | None = None,
+    ) -> None:
+        """Script the next ``n`` device hops to fail (chaos: a
+        corrupted/failed fabric transfer). ``worker`` scopes the fault
+        to hops whose DESTINATION is that worker (a broken link to one
+        shard, the realistic fabric fault); None faults any hop.
+        Default exception is ``ConnectionError`` — retryable, so ``n``
+        below the retry budget exercises recovery-by-retry and ``n``
+        at/above it the terminal :class:`TransferFailed` path."""
+        self._fail_next = int(n)
+        self._fail_exc = exc
+        self._fail_worker = worker
+
+    def _device_put(self, tree, device, dst: str | None = None):
+        """The fault-gated hop; ``device=None`` is the no-hop local
+        path (same gate, so chaos behaves identically on one device)."""
+        if self._fail_next > 0 and (
+            self._fail_worker is None or self._fail_worker == dst
+        ):
+            self._fail_next -= 1
+            self.faults_injected += 1
+            raise (
+                self._fail_exc
+                if self._fail_exc is not None
+                else ConnectionError("chaos: injected page-transfer fault")
+            )
+        if device is None:
+            return tree
+        import jax
+
+        return jax.device_put(tree, device)
+
+    def raw_move(self, tree, device, *, src: str, dst: str, op: str):
+        """One retried device hop. ``device=None`` is the single-device
+        fallback (no hop, but the chaos/fault surface still applies so
+        tests behave identically on one device). Terminal failure
+        raises :class:`TransferFailed` and counts it."""
+        try:
+            if self.retry is not None:
+                return self.retry.call(
+                    lambda: self._device_put(tree, device, dst=dst),
+                    op=op,
+                )
+            return self._device_put(tree, device, dst=dst)
+        except Exception as err:  # noqa: BLE001 - typed terminal surface
+            self.failed += 1
+            if self.instruments is not None:
+                self.instruments.transfer_failed_total.inc()
+            raise TransferFailed(src, dst, err) from err
 
     @staticmethod
     def _live_bytes(chunks_k, chunks_v, n_pages: int) -> int:
@@ -117,16 +206,16 @@ class PageTransferEngine:
         """Move (pred, chunks) to ``dst_device``; returns the moved
         pytree. ``dst_device=None`` keeps the arrays where they are
         (single-device fallback) but still counts — the handoff
-        happened, the fabric hop was just free."""
-        import jax
-
+        happened, the fabric hop was just free. The hop rides
+        :meth:`raw_move`'s bounded retry; a persistent fault surfaces
+        as :class:`TransferFailed`."""
         fr = self.flight_recorder
         ts = time.time() if fr is not None else 0.0
         t0 = time.perf_counter()
-        if dst_device is not None:
-            pred, chunks_k, chunks_v = jax.device_put(
-                (pred, chunks_k, chunks_v), dst_device
-            )
+        pred, chunks_k, chunks_v = self.raw_move(
+            (pred, chunks_k, chunks_v), dst_device,
+            src=src, dst=dst, op=f"transfer.{src}->{dst}",
+        )
         nbytes = self._live_bytes(chunks_k, chunks_v, n_pages)
         self.transfers += 1
         self.pages += int(n_pages)

@@ -239,12 +239,17 @@ def health_from_config(config, service) -> HealthServer | None:
     config (``enabled``, ``port``), or None when disabled (the default).
 
     Registered checks: ``broker`` (connection liveness), ``db`` (a
-    probe read), and — when the reliability subsystem is enabled —
+    probe read), — when the reliability subsystem is enabled —
     ``breaker`` (an OPEN outbound-HTTP circuit breaker means a
     dependency is sick and calls are being fast-failed: the probe
     reports degraded so the orchestrator/operator sees it, while
-    half-open probes recover it without a restart). ``/readyz`` flips
-    once the consumers are registered.
+    half-open probes recover it without a restart), and — when a
+    cluster scheduler is attached (``service.cluster_scheduler``) —
+    ``cluster`` (per-worker up/down/draining + pool pressure; a DOWN
+    decode shard or prefill worker degrades the probe exactly like an
+    open breaker, while draining workers report as detail — planned
+    decommission is not sickness). ``/readyz`` flips once the
+    consumers are registered.
     """
     if not config.get("instance.health.enabled"):
         return None
@@ -279,6 +284,40 @@ def health_from_config(config, service) -> HealthServer | None:
 
         server.add_check("breaker", breaker_check)
 
+    if getattr(service, "cluster", None) is not None:
+        # the scheduler is embedder-owned and usually attached AFTER
+        # boot (service.cluster_scheduler starts None), so the check
+        # resolves it at PROBE time — registration is one-shot, the
+        # lookup is not
+        add_cluster_check(
+            server, lambda: getattr(service, "cluster_scheduler", None)
+        )
+
     server.start()
     server.set_ready(True)
     return server
+
+
+def add_cluster_check(server: HealthServer, scheduler) -> None:
+    """Register the ``cluster`` health check for a
+    :class:`~beholder_tpu.cluster.router.ClusterScheduler` (or a
+    zero-arg callable resolving to one at probe time — None means
+    "configured but not attached yet", a healthy answer): the check
+    fails (degrading ``/healthz`` to 503) while ANY worker is down —
+    mirroring how an open breaker reports — and otherwise returns the
+    per-worker snapshot (state + pool pressure, draining shards
+    included) as detail."""
+
+    def cluster_check():
+        target = scheduler() if callable(scheduler) else scheduler
+        if target is None:
+            return "cluster configured; no scheduler attached"
+        snapshot = target.health_snapshot()
+        if snapshot["down"]:
+            raise RuntimeError(
+                "cluster worker(s) down: "
+                + ", ".join(snapshot["down"])
+            )
+        return snapshot
+
+    server.add_check("cluster", cluster_check)

@@ -614,6 +614,86 @@ def paged_adopt_chunks(
     )
 
 
+def paged_export_pages(state: PagedKVState, page_ids: jax.Array):
+    """Gather pages ``page_ids`` (n,) in POOL REPRESENTATION for a
+    live migration (:mod:`beholder_tpu.cluster.failover`): raw int8
+    values + f32 scales under quantized pools, raw bf16 rows
+    otherwise — NO dequantize/requantize round trip, so the importing
+    pool ends up byte-identical to the source. Returns per-layer
+    (k_chunks, v_chunks) tuples; each quantized layer's chunk is a
+    ``(values, scales)`` pair, a plain pool's the (n, Hkv, Dh, page)
+    rows themselves. The handoff path (:func:`kv_prefill_chunks` /
+    :func:`paged_adopt_chunks`) moves FRESH KV through the cast path
+    instead; this op moves RESIDENT pages verbatim."""
+
+    def take(pool):
+        if isinstance(pool, QuantizedPool):
+            return (pool.values[page_ids], pool.scales[page_ids])
+        return pool[page_ids]
+
+    return (
+        tuple(take(p) for p in state.k_pools),
+        tuple(take(p) for p in state.v_pools),
+    )
+
+
+def paged_import_pages(
+    state: PagedKVState,
+    chunks_k: tuple,
+    chunks_v: tuple,
+    n_pages: jax.Array,
+    refs: jax.Array,
+):
+    """Adopt migrated pages into THIS pool: pop ``n_pages`` pages off
+    the free stack, write the exported chunks VERBATIM (raw values and
+    scales — the byte-identical twin of :func:`paged_export_pages`),
+    and install the SOURCE refcounts ``refs`` (n,) so prefix sharing,
+    cache references and fork structure survive the move. Rows past
+    ``n_pages`` are masked off like every other static-width chunk op.
+    Returns (state, dest_ids) — ``dest_ids[i]`` is the pool page now
+    holding chunk row ``i`` (garbage past ``n_pages``); the host reads
+    it back once to rewrite page tables and cache indexes (migration
+    is an admin operation — the one place a readback is fine)."""
+    num_pages, _ = _pool_geometry(state)
+    p_max = (
+        chunks_k[0][0] if isinstance(chunks_k[0], tuple) else chunks_k[0]
+    ).shape[0]
+    chunk_alive = jnp.arange(p_max) < n_pages
+    pages, new_top, ref, failed = _pop_pages(state, chunk_alive)
+    drop = jnp.where(chunk_alive, pages, num_pages)
+
+    def put(pool, chunk):
+        if isinstance(pool, QuantizedPool):
+            vals, scales = chunk
+            return QuantizedPool(
+                pool.values.at[drop].set(vals, mode="drop"),
+                pool.scales.at[drop].set(scales, mode="drop"),
+            )
+        return pool.at[drop].set(chunk, mode="drop")
+
+    k_pools = tuple(
+        put(pool, ck) for pool, ck in zip(state.k_pools, chunks_k)
+    )
+    v_pools = tuple(
+        put(pool, cv) for pool, cv in zip(state.v_pools, chunks_v)
+    )
+    # _pop_pages seeded the popped pages at refcount 1; the migrated
+    # pages carry their SOURCE counts instead (shared pages stay shared)
+    ref = ref.at[drop].set(
+        jnp.where(chunk_alive, refs, 1), mode="drop"
+    )
+    return (
+        state._replace(
+            k_pools=k_pools,
+            v_pools=v_pools,
+            free_top=new_top,
+            page_ref=ref,
+            alloc_failed=failed,
+        ),
+        pages,
+    )
+
+
 def cache_ref_pages(
     state: PagedKVState, page_ids: jax.Array, alive: jax.Array
 ) -> PagedKVState:
@@ -1004,6 +1084,33 @@ class Request(NamedTuple):
     progress: np.ndarray   # (T+1,) observed progress
     statuses: np.ndarray   # (T+1,) observed statuses
     horizon: int
+    #: optional :class:`beholder_tpu.reliability.policy.Deadline` — the
+    #: request's absolute time budget. None (the default) changes
+    #: nothing; set, the scheduler retires the request with an explicit
+    #: :class:`DeadlineExceededResult` once the budget runs out (checked
+    #: at every host scheduling event: claim and tick-chunk boundaries)
+    #: instead of letting it wedge a slot through a recovery storm.
+    deadline: object = None
+
+
+class DeadlineExceededResult:
+    """Explicit terminal outcome for a request whose
+    :class:`~beholder_tpu.reliability.policy.Deadline` expired before
+    its horizon completed. ``tokens`` carries whatever forecast prefix
+    WAS decoded (empty when the deadline expired before the claim) —
+    the caller gets the partial stream plus an unambiguous outcome
+    instead of a silently short array."""
+
+    __slots__ = ("tokens",)
+    outcome = "deadline_exceeded"
+
+    def __init__(self, tokens: np.ndarray | None = None):
+        self.tokens = (
+            tokens if tokens is not None else np.zeros(0, np.float32)
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"DeadlineExceededResult(tokens={len(self.tokens)})"
 
 
 class _ServingMetrics:
@@ -1334,6 +1441,10 @@ class ContinuousBatcher:
         # hold admitted-but-unreleased pages, so the host's free-page
         # arithmetic no longer mirrors the allocator
         self._poisoned = False
+        #: lazily registered on the FIRST deadline expiry (the failover
+        #: catalog's counter — registering it eagerly would widen the
+        #: pinned default exposition for every batcher with metrics)
+        self._deadline_counter = None
 
     # -- shared helpers -------------------------------------------------
 
@@ -1429,6 +1540,29 @@ class ContinuousBatcher:
         "host headroom checks) — raise num_pages"
     )
 
+    def _count_deadline_exceeded(self, n: int = 1) -> None:
+        """Count deadline expiries on the failover catalog's counter
+        (``beholder_failover_deadline_exceeded_total``) — registered on
+        first use only, so a batcher that never sees a deadline leaves
+        the exposition untouched."""
+        if self._registry is None:
+            return
+        if self._deadline_counter is None:
+            from beholder_tpu.metrics import get_or_create
+
+            self._deadline_counter = get_or_create(
+                self._registry, "counter",
+                "beholder_failover_deadline_exceeded_total",
+                "Requests retired with an expired deadline (explicit "
+                "deadline_exceeded outcome instead of a wedged slot)",
+            )
+        self._deadline_counter.inc(n)
+
+    @staticmethod
+    def _deadline_expired(req) -> bool:
+        deadline = getattr(req, "deadline", None)
+        return deadline is not None and deadline.expired
+
     def _claim_admissions(
         self, queue, results, req_of, free_pages, commit
     ) -> list[tuple[int, int, np.ndarray, int, list, list]]:
@@ -1470,6 +1604,20 @@ class ContinuousBatcher:
                     # skip the prefill/alloc round-trip entirely
                     queue.pop(0)
                     results[rid] = np.zeros(0, np.float32)
+                    continue
+                if self._deadline_expired(req):
+                    # the budget ran out while queued (e.g. a recovery
+                    # storm re-admitting work): explicit outcome, no
+                    # prefill, the slot goes to a request that can
+                    # still make its deadline
+                    queue.pop(0)
+                    results[rid] = DeadlineExceededResult()
+                    self._count_deadline_exceeded()
+                    if fr is not None:
+                        fr.instant(
+                            "deadline_exceeded", trace_id=claim_tid,
+                            stage="claim",
+                        )
                     continue
                 self._check_servable(req)
                 feats_np, t = self._prep_np(req)
@@ -1766,7 +1914,14 @@ class ContinuousBatcher:
             )
             return self.num_pages - int(total_need.sum()) - cold
 
-        def retire_many(done: list[int]):
+        #: rids retired by deadline expiry — their post-readback results
+        #: wrap in DeadlineExceededResult (partial tokens attached)
+        deadline_rids: list[int] = []
+        has_deadlines = any(
+            getattr(r, "deadline", None) is not None for r in requests
+        )
+
+        def retire_many(done: list[int], expired: bool = False):
             """Snapshot + release a retirement round in THREE dispatches
             (two batched gathers + one vectorized release) regardless of
             how many slots finish together. No extra tick runs (the
@@ -1774,15 +1929,20 @@ class ContinuousBatcher:
             could allocate a page for a token nobody reads), and nothing
             crosses to the host — full (cap,) rows are gathered so every
             event's snapshot has a packable shape, with the live widths
-            riding along host-side for the post-fetch trim."""
+            riding along host-side for the post-fetch trim. ``expired``
+            retires slots whose DEADLINE ran out: same snapshot/release
+            machinery (the partial forecast row is still delivered),
+            but the rid is marked for the deadline_exceeded outcome and
+            served tokens count what was actually decoded."""
             with self._round(span, "retire", slots=len(done)):
                 idx = jnp.asarray(done, jnp.int32)
                 rids = [req_of[s] for s in done]
+                widths = [int(written[s]) for s in done]
                 snap_batches.append((
                     rids,
                     carry.delta_buf[idx],
                     carry.last_pred[idx],
-                    [int(written[s]) for s in done],
+                    widths,
                 ))
                 self.state = self._release_many(self.state, idx)
                 for s in done:
@@ -1797,9 +1957,31 @@ class ContinuousBatcher:
                         self.prefix_cache.release(self._slot_chain[s])
                         self._slot_chain[s] = []
                 served[0] += len(done)
-                served[1] += sum(requests[r].horizon for r in rids)
+                if expired:
+                    served[1] += sum(w + 1 for w in widths)
+                    deadline_rids.extend(rids)
+                    self._count_deadline_exceeded(len(done))
+                    if self.flight_recorder is not None:
+                        self.flight_recorder.instant(
+                            "deadline_exceeded", stage="tick",
+                            slots=len(done),
+                        )
+                else:
+                    served[1] += sum(requests[r].horizon for r in rids)
 
         while queue or any(r is not None for r in req_of):
+            if has_deadlines:
+                # deadline sweep at the scheduling-event boundary: an
+                # expired slot retires NOW with its partial forecast —
+                # it must not hold pages through another tick chunk
+                # (the recovery-storm wedge this check exists for)
+                lapsed = [
+                    s for s in range(self.slots)
+                    if req_of[s] is not None
+                    and self._deadline_expired(requests[req_of[s]])
+                ]
+                if lapsed:
+                    retire_many(lapsed, expired=True)
             # admission round: claim every (slot, request) pair that fits
             # under the page-headroom arithmetic (the claim loop — pin-
             # before-evict, deferral, once-per-admission stats — is
@@ -1973,6 +2155,8 @@ class ContinuousBatcher:
             rows_v = got[1 + r :].reshape(r, cap)
             for i, (rid, w) in enumerate(zip(rids, widths)):
                 results[rid] = np.append(rows_v[i, :w], tails_v[i])
+            for rid in deadline_rids:
+                results[rid] = DeadlineExceededResult(results[rid])
         elif bool(jax.device_get(self.state.alloc_failed)):
             raise RuntimeError(self._ALLOCATOR_TRIPPED)
         if self._metrics:

@@ -17,6 +17,15 @@ injects exactly that failure:
 - :func:`trip_allocator` — forces the paged serving state's sticky
   ``alloc_failed`` flag, exercising the scheduler's poisoning path
   without crafting a real pool exhaustion.
+- :class:`WorkerFault` + :func:`inject_worker_fault` — cluster-serving
+  faults on a failover-armed
+  :class:`~beholder_tpu.cluster.router.ClusterScheduler`: ``kill`` a
+  decode shard or prefill worker mid-dispatch (a typed
+  ``WorkerKilled`` after N successful dispatches — genuinely
+  mid-stream), ``hang`` one (heartbeats freeze; the monitor condemns
+  it), or corrupt the next N page ``transfer``\\ s (absorbed by the
+  transfer engine's bounded retry, or surfaced as a terminal
+  ``TransferFailed`` the router recovers from).
 
 Everything lives behind explicit calls; importing this module injects
 nothing.
@@ -111,6 +120,62 @@ def drop_broker_connections(server) -> None:
     """Abort every client connection on an AmqpTestServer — unacked
     deliveries requeue (redelivered=1) and clients must reconnect."""
     server.drop_all_connections()
+
+
+#: cluster worker-fault kinds
+WORKER_KILL = "kill"
+WORKER_HANG = "hang"
+WORKER_TRANSFER_CORRUPTION = "transfer_corruption"
+
+
+class WorkerFault:
+    """A declarative, deterministic cluster worker fault.
+
+    - ``kill``: the worker's dispatch entry point (the decode shard's
+      tick program / the prefill worker's forward) raises a typed
+      ``WorkerKilled`` after ``after_dispatches`` SUCCESSFUL calls —
+      a mid-stream death, not a refusal to start.
+    - ``hang``: the worker's heartbeats freeze; the failover monitor's
+      next sweep marks it down once the beat is stale past the
+      configured miss window.
+    - ``transfer_corruption``: the next ``transfer_failures`` page
+      transfers through the cluster's
+      :class:`~beholder_tpu.cluster.transfer.PageTransferEngine` fail
+      — below the retry budget the hop self-heals, at/above it the
+      terminal ``TransferFailed`` drives shard-level recovery.
+    """
+
+    def __init__(
+        self,
+        worker: str,
+        kind: str = WORKER_KILL,
+        after_dispatches: int = 0,
+        transfer_failures: int = 3,
+    ):
+        if kind not in (
+            WORKER_KILL, WORKER_HANG, WORKER_TRANSFER_CORRUPTION
+        ):
+            raise ValueError(f"unknown worker-fault kind {kind!r}")
+        self.worker = worker
+        self.kind = kind
+        self.after_dispatches = int(after_dispatches)
+        self.transfer_failures = int(transfer_failures)
+
+
+def inject_worker_fault(scheduler, fault: WorkerFault) -> None:
+    """Arm ``fault`` on a failover-enabled
+    :class:`~beholder_tpu.cluster.router.ClusterScheduler`. Raises
+    unless ``instance.cluster.failover`` is armed — without the
+    recovery machinery a faulted cluster just dies, which is the
+    fail-stop behavior the tests for THAT mode inject directly."""
+    engine = getattr(scheduler, "failover", None)
+    if engine is None:
+        raise RuntimeError(
+            "worker faults need a failover-armed cluster — build the "
+            "ClusterScheduler with ClusterConfig(failover="
+            "FailoverConfig(...))"
+        )
+    engine.inject_fault(fault)
 
 
 def trip_allocator(batcher) -> None:

@@ -27,6 +27,9 @@ _default_names = itertools.count()
 SHED_QUEUE_FULL = "queue_full"
 SHED_COST_BACKLOG = "cost_backlog"
 SHED_OVERSIZED = "oversized"
+#: failover: the request would fit SOME shard, but every shard that
+#: could hold it is down/draining — surviving capacity is insufficient
+SHED_SHARD_DOWN = "shard_down"
 
 
 class Admission(NamedTuple):

@@ -243,6 +243,13 @@ class BeholderService:
         from beholder_tpu.cluster import cluster_from_config
 
         self.cluster = cluster_from_config(config)
+        #: set by whatever embeds a live ClusterScheduler next to the
+        #: consumers. The service only holds the reference: /healthz
+        #: gains the ``cluster`` check (degraded while any worker is
+        #: down — health.py), and close() drains it when
+        #: ``instance.cluster.failover.drain_on_sigterm`` (queued work
+        #: serves to completion before the process exits).
+        self.cluster_scheduler = None
 
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
@@ -400,6 +407,20 @@ class BeholderService:
         recorder ring), close."""
         self.logger.info("shutting down")
         self.broker.close()
+        # graceful cluster drain (SIGTERM routes here): stop admitting
+        # and serve what's queued, so a decommission loses nothing
+        if (
+            self.cluster_scheduler is not None
+            and self.cluster is not None
+            and self.cluster.failover is not None
+            and self.cluster.failover.drain_on_sigterm
+        ):
+            try:
+                self.cluster_scheduler.shutdown(drain=True)
+            except Exception as err:  # noqa: BLE001 - best effort on the way out
+                self.logger.warning(
+                    f"cluster drain at shutdown failed: {err!r}"
+                )
         if self.analytics is not None:
             try:
                 self.analytics.flush()

@@ -75,6 +75,14 @@ NOISE_BANDS: dict[str, float] = {
     # the widest band — what it must catch is the handoff path turning
     # from "a few percent around 1x" into a multiple
     "cluster_decode_latency_ratio": 0.50,
+    # recovered-vs-uninterrupted decode wall ratio (the failover bench
+    # kills a live shard mid-trace and re-serves its requests on the
+    # survivor, back to back with an uninterrupted run on the same
+    # host). The ratio structurally exceeds 1 — recovery REPLAYS the
+    # dead shard's work — so the gate bands drift, not the overhead
+    # itself: a regression is the recovery path getting materially
+    # slower relative to its own committed baseline
+    "failover_recovery_overhead_ratio": 0.50,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -132,6 +140,13 @@ def _cluster_decode_ratio(artifact: dict) -> float | None:
     return float(value)
 
 
+def _failover_recovery_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "sections", "failover", "result", "value")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v7 artifact / failover scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -143,6 +158,10 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # disaggregated/colocated wall ratio: a handoff-path regression
     # shows as the ratio RISING (degradation direction "higher")
     ("cluster_decode_latency_ratio", _cluster_decode_ratio, "higher"),
+    # recovered/uninterrupted wall ratio: a recovery-path regression
+    # shows as the ratio RISING
+    ("failover_recovery_overhead_ratio", _failover_recovery_ratio,
+     "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -164,6 +183,16 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "cluster_transferred_pages",
         lambda a: _get(a, "cluster", "transferred_pages"),
+    ),
+    (
+        "failover_recoveries",
+        lambda a: _get(a, "failover", "recoveries"),
+    ),
+    (
+        "failover_recovery_latency_ms",
+        lambda a: _get(
+            a, "sections", "failover", "result", "recovery_latency_ms"
+        ),
     ),
 ]
 

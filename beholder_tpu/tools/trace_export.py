@@ -52,17 +52,30 @@ def load_events(path: str) -> list[dict[str, Any]]:
 #: collide
 WORKER_TID_BASE = 100_000
 
+#: failover-subsystem events: worker failures, drain migrations,
+#: missed heartbeats and deadline retirements render in their own
+#: category (Perfetto can filter/color them apart from serving
+#: phases), as instants — or, for ``drain``, a duration slice — on
+#: the OWNING worker's track (they all carry a ``worker`` arg)
+FAILOVER_EVENTS = frozenset(
+    {"failover", "drain", "heartbeat", "deadline_exceeded"}
+)
+
 
 def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
     """Convert recorder events to the Chrome trace-event format (JSON
     Array Format with metadata, the Perfetto-compatible subset).
 
     Events whose args carry a ``worker`` tag (the cluster subsystem's
-    route/transfer/prefill/claim/tick events) get ONE TRACK PER WORKER
-    instead of one per trace id — a disaggregated serving run reads as
-    parallel worker lanes (``worker decode-0``, ``worker prefill-0``,
-    ...), with the page handoffs visible as slices on the destination
-    worker's lane. Worker-less events keep the per-trace tracks."""
+    route/transfer/prefill/claim/tick events, and the failover
+    subsystem's failover/drain/heartbeat instants) get ONE TRACK PER
+    WORKER instead of one per trace id — a disaggregated serving run
+    reads as parallel worker lanes (``worker decode-0``, ``worker
+    prefill-0``, ...), with the page handoffs visible as slices on the
+    destination worker's lane, worker deaths/missed beats as
+    ``failover``-category instants on the dying worker's lane, and a
+    graceful drain as a duration slice spanning the migration.
+    Worker-less events keep the per-trace tracks."""
     tid_of: dict[str, int] = {}
     worker_tid_of: dict[str, int] = {}
 
@@ -104,7 +117,11 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
             "ts": int(event.get("ts_us", 0)),
             "pid": 1,
             "tid": row,
-            "cat": "serving",
+            "cat": (
+                "failover"
+                if event["name"] in FAILOVER_EVENTS
+                else "serving"
+            ),
             "args": {**event.get("args", {}), "trace_id": trace_id},
         }
         if out["ph"] == "X":

@@ -1,0 +1,934 @@
+"""Fault-tolerant cluster serving: worker kill/hang/transfer chaos with
+bitwise recovery, live-slot + prefix-cache drain migration (bf16 and
+int8, byte-identical), deadline-aware retirement, shard_down shedding,
+healthz degradation, the v7 failover artifact block, and the perf-gate
+band on the recovery-overhead ratio."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.cluster import (
+    ClusterConfig,
+    FailoverConfig,
+    cluster_from_config,
+)
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.metrics import Metrics
+from beholder_tpu.reliability.chaos import (
+    WorkerFault,
+    inject_worker_fault,
+)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.cluster]
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+def _request(seed, t=9, horizon=6, deadline=None):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+        deadline,
+    )
+
+
+BATCHER_KW = dict(
+    num_pages=16, page_size=8, slots=2, max_prefix=16, max_pages_per_seq=4
+)
+
+
+def _mk_cluster(model, state, cfg, **kwargs):
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ClusterScheduler(model, state.params, cfg, **kw)
+
+
+def _mk_single(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ContinuousBatcher(model, state.params, **kw)
+
+
+def _failover_cfg(**kwargs):
+    kw = dict(n_decode_workers=2, failover=FailoverConfig())
+    kw.update(kwargs)
+    return ClusterConfig(**kw)
+
+
+def _assert_pool_pristine(batcher):
+    st = jax.device_get(batcher.state)
+    assert int(st.free_top) == batcher.num_pages
+    assert int(np.asarray(st.page_ref).sum()) == 0
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_failover_config_parse_and_validation():
+    cfg = cluster_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "failover": {
+                            "enabled": True,
+                            "heartbeat_interval_s": 0.5,
+                            "miss_threshold": 2,
+                            "max_recoveries_per_request": 1,
+                            "drain_on_sigterm": False,
+                        },
+                    }
+                }
+            }
+        )
+    )
+    assert cfg.failover is not None
+    assert cfg.failover.heartbeat_interval_s == 0.5
+    assert cfg.failover.miss_threshold == 2
+    assert cfg.failover.max_recoveries_per_request == 1
+    assert cfg.failover.drain_on_sigterm is False
+    # failover disabled (or absent) -> None: the fail-stop cluster
+    off = cluster_from_config(
+        ConfigNode({"instance": {"cluster": {"enabled": True}}})
+    )
+    assert off.failover is None
+    with pytest.raises(ValueError):
+        FailoverConfig(heartbeat_interval_s=0)
+    with pytest.raises(ValueError):
+        FailoverConfig(miss_threshold=0)
+    with pytest.raises(ValueError):
+        FailoverConfig(max_recoveries_per_request=-1)
+
+
+def test_worker_fault_requires_failover(model_state):
+    model, state = model_state
+    cluster = _mk_cluster(model, state, ClusterConfig(n_decode_workers=2))
+    with pytest.raises(RuntimeError, match="failover"):
+        inject_worker_fault(cluster, WorkerFault("decode-0"))
+    with pytest.raises(ValueError, match="kind"):
+        WorkerFault("decode-0", kind="meteor")
+
+
+# -- the acceptance pin: kill a decode shard mid-stream ----------------------
+
+
+def test_kill_decode_shard_mid_stream_bitwise_recovery(model_state):
+    """Killing one of two decode shards mid-stream completes every
+    in-flight request with exact-greedy streams bitwise-identical to
+    an uninterrupted single-engine run, leaves the surviving pool
+    pristine, loses/duplicates no token, and lands the failover
+    counters on /metrics."""
+    model, state = model_state
+    reqs = [_request(i, horizon=5) for i in range(6)]
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=5) for i in range(6)]
+    )
+
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state, _failover_cfg(), metrics=metrics
+    )
+    # after ONE successful tick dispatch: a genuine mid-decode death
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=1)
+    )
+    got = cluster.run(reqs)
+    assert cluster.failover.state("decode-1") == "down"
+    assert cluster.failover.recovered_total > 0
+    for i, (a, b) in enumerate(zip(base, got)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), i
+    _assert_pool_pristine(cluster.shards[0].batcher)
+    exposition = metrics.registry.render()
+    assert "beholder_failover_recoveries_total" in exposition
+    assert (
+        'beholder_failover_worker_up{worker="decode-1"} 0' in exposition
+    )
+    assert (
+        'beholder_failover_worker_failures_total{worker="decode-1"'
+        in exposition
+    )
+    # and the cluster keeps serving on the survivor
+    again = cluster.run([_request(i, horizon=5) for i in range(6)])
+    for a, b in zip(base, again):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kill_prefill_worker_mid_handoff(model_state):
+    """A prefill worker dying mid-handoff fails over to the surviving
+    prefill worker (and, with none left, to the shard's colocated
+    fallback) — streams stay bitwise-identical and the decode shards
+    never notice."""
+    model, state = model_state
+    reqs = [_request(i, horizon=4) for i in range(6)]
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=4) for i in range(6)]
+    )
+
+    # one survivor takes over
+    cluster = _mk_cluster(
+        model, state, _failover_cfg(n_prefill_workers=2)
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("prefill-0", "kill", after_dispatches=1)
+    )
+    got = cluster.run(reqs)
+    assert cluster.failover.state("prefill-0") == "down"
+    assert cluster.failover.state("prefill-1") == "up"
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # no survivor: the shard prefills colocated, still bitwise
+    solo = _mk_cluster(
+        model, state, _failover_cfg(n_prefill_workers=1)
+    )
+    inject_worker_fault(
+        solo, WorkerFault("prefill-0", "kill", after_dispatches=0)
+    )
+    got = solo.run([_request(i, horizon=4) for i in range(6)])
+    assert solo.failover.state("prefill-0") == "down"
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hang_detection_marks_worker_down_and_reroutes(model_state):
+    """A hung worker (frozen heartbeats) is condemned by the monitor's
+    sweep and queued work re-routes to the survivor."""
+    model, state = model_state
+    reqs = [_request(i, horizon=5) for i in range(4)]
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=5) for i in range(4)]
+    )
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(
+            failover=FailoverConfig(
+                heartbeat_interval_s=0.01, miss_threshold=1
+            )
+        ),
+    )
+    for req in reqs:
+        assert cluster.submit(req).accepted
+    inject_worker_fault(cluster, WorkerFault("decode-1", "hang"))
+    results = cluster.run_pending()
+    assert cluster.failover.state("decode-1") == "down"
+    assert len(results) == 4
+    for a, b in zip(base, results):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- transfer faults: bounded retry + typed terminal surface -----------------
+
+
+def test_transfer_fault_absorbed_by_retry(model_state):
+    """A transient transfer fault (below the retry budget) self-heals:
+    the run completes bitwise with zero terminal failures."""
+    model, state = model_state
+    reqs = [_request(i, horizon=4) for i in range(4)]
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=4) for i in range(4)]
+    )
+    cluster = _mk_cluster(
+        model, state, _failover_cfg(n_prefill_workers=1)
+    )
+    inject_worker_fault(
+        cluster,
+        WorkerFault(
+            "decode-0", "transfer_corruption", transfer_failures=1
+        ),
+    )
+    got = cluster.run(reqs)
+    assert cluster.transfer.failed == 0
+    assert cluster.transfer.faults_injected == 1
+    assert cluster.failover.state("decode-0") == "up"
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_transfer_terminal_failure_is_typed_and_recovered(model_state):
+    """Retries exhausted: the hop surfaces a typed TransferFailed —
+    fail-stop clusters raise it to the caller; failover clusters mark
+    the unreachable shard down and recover the batch bitwise."""
+    from beholder_tpu.cluster.transfer import TransferFailed
+
+    model, state = model_state
+    reqs = [_request(i, horizon=4) for i in range(4)]
+
+    # fail-stop: the typed error reaches the caller (not an anonymous
+    # device error through the tick loop)
+    plain = _mk_cluster(
+        model, state,
+        ClusterConfig(n_decode_workers=2, n_prefill_workers=1),
+    )
+    plain.transfer.fail_next(3)  # == max_attempts: every retry burns
+    with pytest.raises(TransferFailed):
+        plain.run([_request(i, horizon=4) for i in range(4)])
+    assert plain.transfer.failed == 1
+
+    # failover: the batch recovers on the surviving shard
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=4) for i in range(4)]
+    )
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(n_prefill_workers=1),
+        metrics=metrics,
+    )
+    inject_worker_fault(
+        cluster,
+        WorkerFault(
+            "decode-0", "transfer_corruption", transfer_failures=3
+        ),
+    )
+    got = cluster.run(reqs)
+    assert cluster.transfer.failed == 1
+    downs = [
+        name for name in ("decode-0", "decode-1")
+        if cluster.failover.state(name) == "down"
+    ]
+    assert len(downs) == 1
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    exposition = metrics.registry.render()
+    assert "beholder_cluster_transfer_failed_total 1" in exposition
+    assert (
+        'beholder_failover_recoveries_total{reason="transfer_failed"}'
+        in exposition
+    )
+
+
+# -- recovery bounds + shard_down shedding -----------------------------------
+
+
+def test_recovery_limit_yields_explicit_dropped_outcome(model_state):
+    """A cascade killing every shard resolves requests to explicit
+    Dropped outcomes (recovery_limit / shard_down) instead of looping
+    or raising through surviving work."""
+    from beholder_tpu.cluster.failover import Dropped
+
+    model, state = model_state
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(
+            failover=FailoverConfig(max_recoveries_per_request=0)
+        ),
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("decode-0", "kill", after_dispatches=0)
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=0)
+    )
+    results = cluster.run([_request(i, horizon=4) for i in range(4)])
+    assert all(isinstance(r, Dropped) for r in results)
+    assert {r.reason for r in results} <= {
+        "recovery_limit", "shard_down"
+    }
+
+
+def test_oversized_on_healthy_failover_cluster_still_raises(model_state):
+    """An always-unservable request is a caller bug, not a shard
+    failure: with every shard healthy the failover cluster raises the
+    batcher's own pool-exhausted error exactly like fail-stop — it
+    must NOT dissolve into a misleading Dropped('shard_down')."""
+    model, state = model_state
+    cluster = _mk_cluster(model, state, _failover_cfg())
+    with pytest.raises(RuntimeError, match="page pool exhausted"):
+        cluster.run([_request(0, horizon=400)])
+
+
+def test_submit_sheds_shard_down_when_survivors_cannot_fit(model_state):
+    from beholder_tpu.cluster.failover import WORKER_DOWN
+
+    model, state = model_state
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state, _failover_cfg(), metrics=metrics
+    )
+    cluster.failover.mark_down("decode-0", "kill")
+    cluster.failover.mark_down("decode-1", "kill")
+    admission = cluster.submit(_request(0, horizon=4))
+    assert not admission.accepted
+    assert admission.reason == "shard_down"
+    exposition = metrics.registry.render()
+    # submit-time rejections land on the intake shed counters only;
+    # dropped_total is reserved for in-flight Dropped outcomes (no
+    # double count of one rejection across both families)
+    assert (
+        'beholder_intake_shed_total{queue="cluster.decode-0",'
+        'reason="shard_down"} 1' in exposition
+    )
+    dropped = metrics.registry.find("beholder_failover_dropped_total")
+    assert dropped.total() == 0
+    assert cluster.failover.states == {
+        "decode-0": WORKER_DOWN, "decode-1": WORKER_DOWN
+    }
+
+
+# -- deadline-aware degraded mode --------------------------------------------
+
+
+class _CountingDeadline:
+    """Deterministic deadline: expires after N .expired probes."""
+
+    def __init__(self, after: int):
+        self.calls = 0
+        self.after = after
+
+    @property
+    def expired(self) -> bool:
+        self.calls += 1
+        return self.calls > self.after
+
+
+def test_deadline_exceeded_is_explicit_and_frees_the_slot(model_state):
+    from beholder_tpu.models.serving import DeadlineExceededResult
+    from beholder_tpu.reliability.policy import Deadline
+
+    model, state = model_state
+    metrics = Metrics()
+    batcher = _mk_single(model, state, metrics=metrics)
+    # expired while queued -> zero-token outcome at claim
+    res = batcher.run([
+        _request(0, horizon=3),
+        _request(1, horizon=3, deadline=Deadline.after(-1.0)),
+        _request(2, horizon=3),
+    ])
+    assert isinstance(res[1], DeadlineExceededResult)
+    assert res[1].tokens.shape == (0,)
+    base = _mk_single(model, state).run(
+        [_request(0, horizon=3), _request(2, horizon=3)]
+    )
+    assert np.array_equal(res[0], base[0])
+    assert np.array_equal(res[2], base[1])
+    # expired mid-flight -> partial stream, a bitwise PREFIX of the
+    # uninterrupted run, and the slot/pages come back
+    b2 = _mk_single(model, state, metrics=metrics)
+    res2 = b2.run([
+        _request(0, horizon=3),
+        _request(3, horizon=8, deadline=_CountingDeadline(1)),
+        _request(2, horizon=3),
+    ])
+    partial = res2[1]
+    assert isinstance(partial, DeadlineExceededResult)
+    assert 0 < len(partial.tokens) < 8
+    full = _mk_single(model, state).run([
+        _request(0, horizon=3), _request(3, horizon=8),
+        _request(2, horizon=3),
+    ])
+    assert np.array_equal(
+        partial.tokens, np.asarray(full[1])[: len(partial.tokens)]
+    )
+    _assert_pool_pristine(b2)
+    assert (
+        "beholder_failover_deadline_exceeded_total 2"
+        in metrics.registry.render()
+    )
+    # without deadlines the lazily registered series never appears
+    clean = Metrics()
+    _mk_single(model, state, metrics=clean).run(
+        [_request(0, horizon=3)]
+    )
+    assert "deadline" not in clean.registry.render()
+
+
+def test_deadline_threads_through_cluster_disaggregated_loop(model_state):
+    from beholder_tpu.models.serving import DeadlineExceededResult
+
+    model, state = model_state
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(
+            n_prefill_workers=1, route_policy="round_robin"
+        ),
+    )
+    # round-robin pairs the deadline'd request with a short-horizon
+    # one on its shard, so the short retirement creates the mid-flight
+    # scheduling event where the expiry sweep runs
+    res = cluster.run([
+        _request(0, horizon=3),
+        _request(3, horizon=8, deadline=_CountingDeadline(1)),
+        _request(2, horizon=3),
+        _request(4, horizon=3),
+    ])
+    assert isinstance(res[1], DeadlineExceededResult)
+    assert 0 < len(res[1].tokens) < 8
+    assert np.asarray(res[0]).shape == (3,)
+    assert np.asarray(res[2]).shape == (3,)
+    assert np.asarray(res[3]).shape == (3,)
+
+
+# -- graceful drain ----------------------------------------------------------
+
+
+def test_drain_migrates_queued_work_cache_pins_and_serves_warm(model_state):
+    """The drain acceptance leg: under pool pressure with warm prefix
+    pins and spec decode armed, draining a shard moves its queued work
+    and cached pages to the survivor with zero loss — warm replays hit
+    the MIGRATED cache bitwise, and a later full eviction leaves the
+    surviving pool pristine (refcounts moved wholesale)."""
+    from beholder_tpu.cache import PrefixCache
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    spec_kw = dict(num_pages=24, max_pages_per_seq=6)
+    reqs = [_request(i % 2, t=9, horizon=4) for i in range(4)]
+    base = _mk_single(
+        model, state,
+        spec=SpecConfig(max_draft=3, accept_tol=0.0),
+        prefix_cache=PrefixCache(BATCHER_KW["page_size"]),
+        **spec_kw,
+    ).run_spec([_request(i % 2, t=9, horizon=4) for i in range(4)])
+
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(route_policy="round_robin"),
+        metrics=metrics,
+        spec=SpecConfig(max_draft=3, accept_tol=0.0),
+        prefix_cache_factory=lambda: PrefixCache(
+            BATCHER_KW["page_size"]
+        ),
+        **spec_kw,
+    )
+    cold = cluster.run(list(reqs))
+    for a, b in zip(base, cold):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert cluster.shards[0].batcher.prefix_cache.page_count > 0
+
+    # queue work on the doomed shard, then drain it
+    for req in reqs:
+        assert cluster.submit(req).accepted
+    queued_before = sum(s.intake.depth for s in cluster.shards)
+    outcome = cluster.drain(0)
+    assert outcome["migrated_pages"] > 0
+    # a COMPLETED planned decommission is "drained", not "down" —
+    # the health check must not degrade for it
+    assert cluster.failover.state("decode-0") == "drained"
+    snap = cluster.health_snapshot()
+    assert snap["down"] == [] and snap["drained"] == ["decode-0"]
+    assert (
+        sum(s.intake.depth for s in cluster.shards) == queued_before
+    )
+    survivor = cluster.shards[1].batcher
+    hits_before = survivor.prefix_cache.hits
+    drained = cluster.run_pending()
+    assert len(drained) == len(reqs)
+    for a, b in zip(base, drained):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # replays actually hit the (partly migrated) survivor cache
+    assert survivor.prefix_cache.hits > hits_before
+    exposition = metrics.registry.render()
+    assert "beholder_failover_drains_total 1" in exposition
+    assert "beholder_failover_migrated_pages_total" in exposition
+    # migrated refcounts are exact: a full eviction returns every page
+    survivor._evict_cached(survivor.num_pages)
+    _assert_pool_pristine(survivor)
+
+
+def test_drain_requires_failover_and_survivors(model_state):
+    from beholder_tpu.cluster.failover import DrainError
+
+    model, state = model_state
+    plain = _mk_cluster(model, state, ClusterConfig(n_decode_workers=2))
+    with pytest.raises(RuntimeError, match="failover"):
+        plain.drain(0)
+    solo = _mk_cluster(
+        model, state,
+        ClusterConfig(n_decode_workers=1, failover=FailoverConfig()),
+    )
+    with pytest.raises(DrainError, match="last healthy"):
+        solo.drain(0)
+    assert solo.failover.state("decode-0") == "up"  # rolled back
+
+
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+def test_migrate_pool_live_slots_byte_identical(model_state, cache_dtype):
+    """The live-slot migration primitive: active slots (including a
+    refcount-shared fork) move across a real device hop with
+    destination pages BYTE-identical (raw int8 values + scales under
+    quantized pools — no requantize round trip), refcounts preserved,
+    and continued decode bitwise-identical to an unmigrated rollout."""
+    from beholder_tpu.cluster.failover import migrate_pool
+    from beholder_tpu.cluster.transfer import PageTransferEngine
+    from beholder_tpu.models.serving import (
+        paged_admit_batch,
+        paged_decode_tick,
+        paged_fork,
+    )
+    from beholder_tpu.ops import NUM_STATUSES
+
+    model, state = model_state
+    dtype = jnp.int8 if cache_dtype == "int8" else jnp.bfloat16
+    kw = dict(BATCHER_KW, slots=4, cache_dtype=dtype)
+    devs = jax.devices()
+
+    def build(device):
+        b = _mk_single(model, state, **{k: v for k, v in kw.items()
+                                        if k not in ("max_prefix",)},
+                       max_prefix=16)
+        b.state = jax.device_put(b.state, device)
+        b.params = jax.device_put(b.params, device)
+        return b
+
+    src = build(devs[0])
+    dst = build(devs[1 % len(devs)])
+    rng = np.random.default_rng(3)
+    feats = rng.normal(0, 1, (2, 16, 1 + NUM_STATUSES)).astype(
+        np.float32
+    )
+    _, src.state = paged_admit_batch(
+        model, src.params, src.state,
+        jnp.asarray([0, 1], jnp.int32), jnp.asarray(feats),
+        jnp.asarray([13, 9], jnp.int32),
+    )
+    src.state = paged_fork(
+        src.state, jnp.int32(0), jnp.asarray([2], jnp.int32)
+    )
+    src_snap = jax.device_get(src.state)
+
+    moved = migrate_pool(
+        src, dst, PageTransferEngine(), src="src", dst="dst"
+    )
+    refs_src = np.asarray(src_snap.page_ref)
+    assert moved == int((refs_src > 0).sum())
+    assert src._poisoned  # the source is decommissioned
+
+    dst_snap = jax.device_get(dst.state)
+    t_src = np.asarray(src_snap.page_table)
+    t_dst = np.asarray(dst_snap.page_table)
+    for s in range(3):
+        assert int(dst_snap.seq_lens[s]) == int(src_snap.seq_lens[s])
+        assert bool(dst_snap.active[s])
+        count = -(-int(src_snap.seq_lens[s]) // BATCHER_KW["page_size"])
+        for j in range(count):
+            o, d = int(t_src[s, j]), int(t_dst[s, j])
+            assert int(refs_src[o]) == int(
+                np.asarray(dst_snap.page_ref)[d]
+            )
+            for layer in range(model.layers):
+                for pool_s, pool_d in (
+                    (src_snap.k_pools[layer], dst_snap.k_pools[layer]),
+                    (src_snap.v_pools[layer], dst_snap.v_pools[layer]),
+                ):
+                    if hasattr(pool_s, "values"):  # quantized: raw
+                        assert np.array_equal(
+                            np.asarray(pool_s.values)[o],
+                            np.asarray(pool_d.values)[d],
+                        )
+                        assert np.array_equal(
+                            np.asarray(pool_s.scales)[o],
+                            np.asarray(pool_d.scales)[d],
+                        )
+                    else:
+                        assert np.array_equal(
+                            np.asarray(pool_s)[o],
+                            np.asarray(pool_d)[d],
+                        )
+
+    # continued decode on the migrated pool == an unmigrated reference
+    ref = build(devs[0])
+    _, ref.state = paged_admit_batch(
+        model, ref.params, ref.state,
+        jnp.asarray([0, 1], jnp.int32), jnp.asarray(feats),
+        jnp.asarray([13, 9], jnp.int32),
+    )
+    ref.state = paged_fork(
+        ref.state, jnp.int32(0), jnp.asarray([2], jnp.int32)
+    )
+    feats_t = rng.normal(0, 1, (4, 1 + NUM_STATUSES)).astype(np.float32)
+    for _ in range(3):
+        pr_ref, ref.state = paged_decode_tick(
+            model, ref.params, ref.state, jnp.asarray(feats_t)
+        )
+        pr_dst, dst.state = paged_decode_tick(
+            model, dst.params, dst.state, jnp.asarray(feats_t)
+        )
+        assert np.array_equal(
+            np.asarray(jax.device_get(pr_ref)),
+            np.asarray(jax.device_get(pr_dst)),
+        )
+
+
+# -- splice ledger: no token emitted twice or skipped ------------------------
+
+
+def test_splice_never_duplicates_or_skips_and_rejects_divergence():
+    from beholder_tpu.cluster import FailoverConfig as FC
+    from beholder_tpu.cluster.failover import FailoverEngine
+
+    class _Router:
+        shards = []
+        prefill_workers = []
+
+    engine = FailoverEngine(_Router(), FC())
+    replay = np.arange(6, dtype=np.float32)
+    # nothing delivered: pass-through
+    assert np.array_equal(engine.splice("r", replay), replay)
+    # a delivered prefix splices exactly once — and the ledger entry
+    # is CONSUMED (run() reuses keys across calls, so a surviving
+    # entry would splice stale tokens into the next run)
+    engine.record_emitted("r", replay[:3])
+    out = engine.splice("r", replay)
+    assert np.array_equal(out, replay)
+    assert np.array_equal(engine.splice("r", replay * 2), replay * 2)
+    # a diverging replay is refused loudly, never silently emitted
+    engine.record_emitted("r", replay[:3])
+    bad = replay.copy()
+    bad[1] = 99.0
+    with pytest.raises(RuntimeError, match="diverged"):
+        engine.splice("r", bad)
+    # terminal outcomes sweep their entries too
+    engine.record_emitted("q", replay[:2])
+    engine.discard_emitted(["q"])
+    assert np.array_equal(engine.splice("q", replay), replay)
+
+
+# -- healthz -----------------------------------------------------------------
+
+
+def test_healthz_cluster_check_reports_degraded(model_state):
+    from beholder_tpu.health import HealthServer, add_cluster_check
+
+    model, state = model_state
+    cluster = _mk_cluster(model, state, _failover_cfg())
+    server = HealthServer()
+    add_cluster_check(server, cluster)
+    healthy, checks = server.snapshot()
+    assert healthy
+    assert checks["cluster"]["ok"]
+    assert (
+        checks["cluster"]["detail"]["workers"]["decode-0"]["state"]
+        == "up"
+    )
+    cluster.failover.mark_down("decode-1", "kill")
+    healthy, checks = server.snapshot()
+    assert not healthy
+    assert not checks["cluster"]["ok"]
+    assert "decode-1" in checks["cluster"]["detail"]
+
+
+def test_service_wires_cluster_check_and_drains_on_close(model_state):
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import BeholderService
+    from beholder_tpu.storage import MemoryStorage
+
+    model, state = model_state
+    service = BeholderService(
+        ConfigNode({
+            "keys": {"trello": {"key": "K", "token": "T"}},
+            "instance": {
+                "health": {"enabled": True},
+                "cluster": {
+                    "enabled": True,
+                    "failover": {"enabled": True},
+                },
+            },
+        }),
+        InMemoryBroker(), MemoryStorage(),
+    )
+    assert service.cluster.failover is not None
+    assert service.cluster_scheduler is None  # embedder-owned
+    from beholder_tpu.health import health_from_config
+
+    # the realistic order: health boots FIRST, the scheduler attaches
+    # later — the check resolves it at probe time
+    service.health = health_from_config(service.config, service)
+    healthy, checks = service.health.snapshot()
+    assert "cluster" in checks and checks["cluster"]["ok"]
+    assert "no scheduler attached" in checks["cluster"]["detail"]
+    cluster = _mk_cluster(model, state, _failover_cfg())
+    service.cluster_scheduler = cluster
+    try:
+        healthy, checks = service.health.snapshot()
+        assert "cluster" in checks and checks["cluster"]["ok"]
+        cluster.failover.mark_down("decode-1", "kill")
+        healthy, checks = service.health.snapshot()
+        assert not healthy and not checks["cluster"]["ok"]
+        cluster.failover._set_state("decode-1", "up")
+    finally:
+        # drain_on_sigterm: close() serves what's queued, then marks
+        # the shards draining so nothing new admits
+        assert cluster.submit(_request(0, horizon=3)).accepted
+        service.close()
+    assert all(s.intake.depth == 0 for s in cluster.shards)
+    assert cluster.failover.state("decode-0") == "draining"
+    assert not cluster.submit(_request(1, horizon=3)).accepted
+
+
+# -- observability: events, trace export, artifact v7, perf gate -------------
+
+
+def test_failover_events_render_on_worker_tracks(model_state):
+    from beholder_tpu.obs import FlightRecorder
+    from beholder_tpu.tools import trace_export
+
+    model, state = model_state
+    recorder = FlightRecorder(ring_size=512)
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(route_policy="round_robin"),
+        metrics=metrics, flight_recorder=recorder,
+        prefix_cache_factory=None,
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=1)
+    )
+    cluster.run([_request(i, horizon=5) for i in range(6)])
+    # a second cluster shares the ring for the drain slice
+    drained = _mk_cluster(
+        model, state, _failover_cfg(), flight_recorder=recorder
+    )
+    drained.run([_request(i, horizon=4) for i in range(2)])
+    drained.drain(0)
+    events = recorder.events()
+    names = {e["name"] for e in events}
+    assert {"failover", "drain"} <= names
+    failover_events = [e for e in events if e["name"] == "failover"]
+    assert all("worker" in e["args"] for e in failover_events)
+
+    trace = trace_export.chrome_trace(events)
+    by_cat = {}
+    for event in trace["traceEvents"]:
+        by_cat.setdefault(event.get("cat"), []).append(event)
+    assert "failover" in by_cat
+    for event in by_cat["failover"]:
+        # failover events land on the owning worker's track
+        assert event["tid"] >= trace_export.WORKER_TID_BASE
+        if event["name"] == "drain":
+            assert event["ph"] == "X"  # the migration is a slice
+        elif event["ph"] == "i":
+            assert event["s"] == "t"
+
+
+def test_heartbeat_miss_event_recorded(model_state):
+    from beholder_tpu.obs import FlightRecorder
+
+    model, state = model_state
+    recorder = FlightRecorder(ring_size=64)
+    cluster = _mk_cluster(
+        model, state,
+        _failover_cfg(
+            failover=FailoverConfig(
+                heartbeat_interval_s=0.01, miss_threshold=1
+            )
+        ),
+        flight_recorder=recorder,
+    )
+    inject_worker_fault(cluster, WorkerFault("decode-0", "hang"))
+    cluster.failover.sweep()
+    names = [e["name"] for e in recorder.events()]
+    assert "heartbeat" in names
+    assert "failover" in names
+    beat = next(
+        e for e in recorder.events() if e["name"] == "heartbeat"
+    )
+    assert beat["args"]["worker"] == "decode-0"
+    assert beat["args"]["age_s"] > 0
+
+
+def test_artifact_v7_failover_block_records_and_validates():
+    from beholder_tpu.cluster.instruments import FailoverMetrics
+    from beholder_tpu.metrics import Registry
+
+    registry = Registry()
+    fm = FailoverMetrics(registry)
+    fm.recoveries_total.inc(3, reason="kill")
+    fm.migrated_pages_total.inc(5)
+    fm.deadline_exceeded_total.inc(2)
+
+    rec = artifact.ArtifactRecorder("t")
+    rec.record_failover(registry)
+    obj = rec.to_dict()
+    artifact.validate(obj)
+    assert obj["schema_version"] >= 7
+    assert obj["failover"] == {
+        "recoveries": 3.0,
+        "migrated_pages": 5.0,
+        "deadline_exceeded": 2.0,
+    }
+    broken = dict(obj)
+    broken.pop("failover")
+    with pytest.raises(ValueError, match="failover"):
+        artifact.validate(broken)
+    # pre-v7 artifacts stay valid without the block
+    v6 = dict(obj, schema_version=6)
+    v6.pop("failover", None)
+    artifact.validate(v6)
+
+
+def test_perf_gate_bands_failover_recovery_ratio():
+    from beholder_tpu.tools import perf_gate
+
+    def mk(value):
+        return {"sections": {"failover": {"result": {"value": value}}}}
+
+    ok = perf_gate.run_gate(mk(1.5), mk(1.8))
+    check = next(
+        c for c in ok["checks"]
+        if c["metric"] == "failover_recovery_overhead_ratio"
+    )
+    assert check["ok"]
+    bad = perf_gate.run_gate(mk(1.5), mk(2.5))
+    check = next(
+        c for c in bad["checks"]
+        if c["metric"] == "failover_recovery_overhead_ratio"
+    )
+    assert not check["ok"]  # the overhead RISING past the band fails
+    skipped = perf_gate.run_gate({"sections": {}}, mk(1.5))
+    assert "failover_recovery_overhead_ratio" in [
+        s["metric"] for s in skipped["skipped"]
+    ]
+
+
+def test_failover_off_keeps_cluster_fail_stop_and_exposition(model_state):
+    """Without instance.cluster.failover the cluster stays fail-stop
+    (a kill propagates) and registers no beholder_failover series."""
+    from beholder_tpu.cluster.failover import WorkerKilled
+
+    model, state = model_state
+    metrics = Metrics()
+    cluster = _mk_cluster(
+        model, state, ClusterConfig(n_decode_workers=2),
+        metrics=metrics,
+    )
+    assert cluster.failover is None
+    # inject the raise directly (inject_worker_fault refuses, above)
+    batcher = cluster.shards[1].batcher
+    orig = batcher._tick_chunk
+
+    def killer(*args, **kwargs):
+        raise WorkerKilled("decode-1")
+
+    batcher._tick_chunk = killer
+    with pytest.raises(WorkerKilled):
+        cluster.run([_request(i, horizon=5) for i in range(6)])
+    batcher._tick_chunk = orig
+    assert "beholder_failover" not in metrics.registry.render()
