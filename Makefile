@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo perf-gate lint clean
 
 all: proto native
 
@@ -77,6 +77,15 @@ bench-failover:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" JAX_PLATFORMS=cpu \
 		python bench.py --failover-only
 
+# the SLO scenario alone: a decode-heavy mix through submit/run_pending
+# with the flight recorder armed and the SLO tracker attached as a
+# recorder listener — live streaming TTFT/TPOT digests, attainment,
+# and the per-request timeline reconciliation (writes
+# artifacts/bench_slo.json; the full `make bench` run carries the same
+# scenario inside bench_e2e.json's v8 slo block)
+bench-slo:
+	python bench.py --slo-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -93,6 +102,8 @@ perf-gate:
 		--baseline artifacts/bench_cluster.json --current artifacts/bench_cluster.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_failover.json --current artifacts/bench_failover.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_slo.json --current artifacts/bench_slo.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

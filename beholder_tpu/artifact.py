@@ -47,7 +47,10 @@ Schema (``validate`` is the authoritative checker)::
                   "transferred_pages": 0.0, "routed": 0.0,
                   "sheds_by_shard": {}},  # v6: cluster serving
       "failover": {"recoveries": 0.0, "migrated_pages": 0.0,
-                   "deadline_exceeded": 0.0}  # v7: fault tolerance
+                   "deadline_exceeded": 0.0},  # v7: fault tolerance
+      "slo": {"ttft_p50_ms": 0.0, "ttft_p95_ms": 0.0,
+              "tpot_p50_ms": 0.0, "attainment": 0.0,
+              "worst_request": {}}  # v8: request-level SLO digests
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -101,6 +104,14 @@ through a recovery (the ``bench.py --failover-only`` scenario kills a
 live shard mid-trace) now says so; the CI gate asserts the committed
 artifact exercised the recovery path (``recoveries > 0``). v1-v6
 artifacts remain valid.
+
+Schema v8 (the SLO PR): the run's request-level latency digests ride
+along (:meth:`ArtifactRecorder.record_slo`) — streaming p50/p95 TTFT
+and p50 TPOT from the SLO tracker's bounded-memory P² digests,
+objective attainment, and the worst request seen. The perf gate bands
+the p95/p50 TTFT tail ratio and attainment (environment-normalized;
+absolute milliseconds are reported, never gated — the BENCH_NOTES
+drift doctrine). v1-v7 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -112,7 +123,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -182,6 +193,16 @@ FAILOVER_COUNTERS = {
     "recoveries": "beholder_failover_recoveries_total",
     "migrated_pages": "beholder_failover_migrated_pages_total",
     "deadline_exceeded": "beholder_failover_deadline_exceeded_total",
+}
+
+#: v8: the slo block's required shape (an empty block is valid — a run
+#: that never armed an SLO tracker still writes a v8 artifact)
+EMPTY_SLO = {
+    "ttft_p50_ms": 0.0,
+    "ttft_p95_ms": 0.0,
+    "tpot_p50_ms": 0.0,
+    "attainment": 0.0,
+    "worst_request": {},
 }
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
@@ -264,6 +285,7 @@ class ArtifactRecorder:
         self.failover: dict[str, float] = {
             key: 0.0 for key in FAILOVER_COUNTERS
         }
+        self.slo: dict[str, Any] = copy.deepcopy(EMPTY_SLO)
 
     def section(
         self,
@@ -406,6 +428,17 @@ class ArtifactRecorder:
             if counter is not None:
                 self.failover[key] += float(counter.total())
 
+    def record_slo(self, summary: dict[str, Any]) -> None:
+        """Adopt one SLO tracker summary
+        (:meth:`beholder_tpu.obs.slo.SLOTracker.artifact_summary`) as
+        the run's v8 ``slo`` block. Last writer wins — a bench records
+        its headline serving scenario's digests (quantiles don't sum
+        across scenarios)."""
+        for key in EMPTY_SLO:
+            if key not in summary:
+                raise ValueError(f"slo summary missing {key!r}")
+        self.slo = copy.deepcopy({key: summary[key] for key in EMPTY_SLO})
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -450,6 +483,7 @@ class ArtifactRecorder:
             "attribution": copy.deepcopy(self.attribution),
             "cluster": copy.deepcopy(self.cluster),
             "failover": dict(self.failover),
+            "slo": copy.deepcopy(self.slo),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -532,6 +566,14 @@ def record_failover(registry) -> None:
     as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_failover(registry)
+
+
+def record_slo(summary: dict) -> None:
+    """Adopt an SLO tracker summary into the active recorder's v8
+    ``slo`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_slo(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -660,6 +702,24 @@ def validate(obj: Any) -> None:
                         f"failover.{key} must be a number, "
                         f"got {failover.get(key)!r}"
                     )
+    if isinstance(version, int) and version >= 8:
+        # v8: request-level SLO digests are part of the evidence
+        slo = obj.get("slo")
+        if not isinstance(slo, dict):
+            problems.append("slo must be a dict (schema v8+)")
+        else:
+            for key in EMPTY_SLO:
+                if key == "worst_request":
+                    continue
+                if not isinstance(slo.get(key), (int, float)):
+                    problems.append(
+                        f"slo.{key} must be a number, got {slo.get(key)!r}"
+                    )
+            if not isinstance(slo.get("worst_request"), dict):
+                problems.append(
+                    "slo.worst_request must be a dict, "
+                    f"got {slo.get('worst_request')!r}"
+                )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):
         problems.append("raw_timings must be a list")

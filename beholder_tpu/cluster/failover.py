@@ -358,10 +358,20 @@ class FailoverEngine:
                 "failover", worker=worker, reason=reason, recovered=n
             )
 
-    def drop(self, reason: str) -> Dropped:
+    def drop(self, reason: str, key=None) -> Dropped:
+        """Resolve one request to an explicit :class:`Dropped` outcome.
+        ``key`` (the request's timeline gid, when the caller has one)
+        also emits a recorder-only ``req.dropped`` lifecycle instant —
+        a lost request must be VISIBLE to the SLO layer (a recovery
+        storm that drops requests while attainment reads 1.0 would be
+        exactly the blind spot the burn-rate page exists to close)."""
         self.dropped_total += 1
         if self.instruments is not None:
             self.instruments.dropped_total.inc(reason=reason)
+        if self.flight_recorder is not None and key is not None:
+            self.flight_recorder.instant(
+                "req.dropped", gid=key, reason=reason
+            )
         return Dropped(reason)
 
     def shed(self, reason: str):
@@ -464,11 +474,23 @@ class FailoverEngine:
         ts = time.time()
         t0 = time.perf_counter()
 
-        # 2. queued work moves first (it holds no device state)
-        pending = shard.intake.take_all()
+        # 2. queued work moves first (it holds no device state). The
+        # original enqueue stamps migrate WITH the items, so the
+        # eventual claim still measures the full queue wait
+        # a re-pack onto survivors, not a claim: waits stay OFF the
+        # histogram (the claiming drain observes the one true wait);
+        # the (items, stamps) pair is read atomically — a second-step
+        # attribute read could be clobbered by a concurrent drain and
+        # zip-drop every pending item
+        pending, _, pending_stamps = shard.intake.drain_all(
+            record_waits=False
+        )
         requeued = 0
         moves: dict[int, list] = {s.pool.shard_id: [] for s in survivors}
-        for item in pending:
+        move_stamps: dict[int, list[float]] = {
+            s.pool.shard_id: [] for s in survivors
+        }
+        for item, stamp in zip(pending, pending_stamps):
             request = item[1]
             need = router._need(request)
             shard.pool.release(need)
@@ -477,8 +499,11 @@ class FailoverEngine:
                 # ONE family records the loss: the request resolves to
                 # a Dropped outcome (dropped_total) — it was already
                 # counted admitted at submit, so re-shedding it on the
-                # intake counters would double-report one request
-                router._pending_drops[item[0]] = self.drop(SHED_SHARD_DOWN)
+                # intake counters would double-report one request. The
+                # submit-seq gid keeps the loss on the SLO books too
+                router._pending_drops[item[0]] = self.drop(
+                    SHED_SHARD_DOWN, key=f"s{item[0]}"
+                )
                 continue
             target = router.shards[
                 router.pool_view.least_pressure(
@@ -487,12 +512,16 @@ class FailoverEngine:
             ]
             target.pool.reserve(need)
             moves[target.pool.shard_id].append(item)
+            move_stamps[target.pool.shard_id].append(stamp)
             router._record_route(target, "drain", need, 0.0, time.time())
             requeued += 1
         for target in survivors:
             items = moves[target.pool.shard_id]
             if items:
-                target.intake.restock(items)
+                target.intake.restock(
+                    items,
+                    enqueued_at=move_stamps[target.pool.shard_id],
+                )
 
         # 3. resident pool state moves byte-identically. A migration
         # failure (destination capacity, fabric) rolls the shard back

@@ -202,6 +202,11 @@ class ClusterScheduler:
         self._pf_rr = 0
         #: monotone submit sequence — the admission-order key
         self._seq = 0
+        #: timeline gid epoch: one per _serve_pairs call, so request
+        #: gids recur NEVER across calls but stay stable across a
+        #: call's recovery passes (a recovered request's re-claim lands
+        #: on the same timeline as a new leg)
+        self._gid_epoch = 0
 
     # -- introspection ---------------------------------------------------
 
@@ -410,7 +415,7 @@ class ClusterScheduler:
         out = self._serve_pairs(list(enumerate(requests)))
         return [out[gid] for gid in range(len(requests))]
 
-    def _serve_pairs(self, pairs: list) -> dict:
+    def _serve_pairs(self, pairs: list, waits: dict | None = None) -> dict:
         """Route + serve ``(key, request)`` pairs; returns
         ``{key: result}``. Fail-stop (no failover) this is one pass —
         route everything, serve shard by shard, exceptions propagate —
@@ -437,6 +442,12 @@ class ClusterScheduler:
         pending = list(pairs)
         attempts: dict = {}
         pass_index = 0
+        self._gid_epoch += 1
+        gid_of = (
+            {key: f"g{self._gid_epoch}-{key}" for key, _ in pairs}
+            if self.flight_recorder is not None
+            else {}
+        )
         while pending:
             if fo is not None:
                 fo.sweep()
@@ -457,7 +468,9 @@ class ClusterScheduler:
                         # A request NO shard could ever hold falls
                         # through to the batcher's own oversized error
                         # — that is a caller bug, not a shard failure
-                        out[key] = fo.drop(SHED_SHARD_DOWN)
+                        out[key] = fo.drop(
+                            SHED_SHARD_DOWN, key=gid_of.get(key)
+                        )
                         continue
                 shard = self._route(need)
                 shard.pool.reserve(need)
@@ -470,6 +483,23 @@ class ClusterScheduler:
                     continue
                 if fo is not None:
                     fo.begin_serve(shard.pool.name)
+                if self.flight_recorder is not None:
+                    # request-level timeline identity: the gid keys
+                    # this request's claim/retire instants across
+                    # shards AND recovery passes; the intake wait (when
+                    # this drain came through run_pending) rides along
+                    shard.batcher.annotate_requests({
+                        rid: {
+                            "gid": gid_of[key],
+                            "worker": shard.pool.name,
+                            **(
+                                {"queue_wait_s": round(waits[key], 6)}
+                                if waits and key in waits
+                                else {}
+                            ),
+                        }
+                        for rid, (key, _, _) in enumerate(items)
+                    })
                 try:
                     served = self._serve(
                         shard, [req for _, req, _ in items]
@@ -491,10 +521,23 @@ class ClusterScheduler:
                             attempts[key]
                             > fo.config.max_recoveries_per_request
                         ):
-                            out[key] = fo.drop("recovery_limit")
+                            out[key] = fo.drop(
+                                "recovery_limit", key=gid_of.get(key)
+                            )
                         else:
                             pending.append((key, req))
                             retried += 1
+                            if self.flight_recorder is not None:
+                                # per-request recovery marker: the
+                                # timeline layer attributes the
+                                # recovery leg to the request that
+                                # paid it (obs/timeline.py)
+                                self.flight_recorder.instant(
+                                    "req.recovered",
+                                    gid=gid_of[key],
+                                    worker=shard.pool.name,
+                                    reason=kind,
+                                )
                     fo.count_recovered(shard.pool.name, kind, retried)
                     continue
                 finally:
@@ -580,10 +623,23 @@ class ClusterScheduler:
         self._rebalance()
         collected: list[tuple[int, np.ndarray]] = []
         for shard in self.shards:
-            pending = shard.intake.take_all()
+            pending, drain_waits, _ = shard.intake.drain_all()
             if not pending:
                 continue
             requests = [req for _, req in pending]
+            if self.flight_recorder is not None:
+                shard.batcher.annotate_requests({
+                    rid: {
+                        "gid": f"s{seq}",
+                        "worker": shard.pool.name,
+                        **(
+                            {"queue_wait_s": round(drain_waits[rid], 6)}
+                            if rid < len(drain_waits)
+                            else {}
+                        ),
+                    }
+                    for rid, (seq, _) in enumerate(pending)
+                })
             served = self._serve(shard, requests)
             for req in requests:
                 shard.pool.release(self._need(req))
@@ -605,14 +661,16 @@ class ClusterScheduler:
         recovery-aware ``_serve_pairs`` in admission order."""
         self.failover.sweep()
         pairs: list[tuple[int, object]] = []
+        waits: dict[int, float] = {}
         for shard in self.shards:
-            pending = shard.intake.take_all()
-            for seq, req in pending:
+            pending, drain_waits, _ = shard.intake.drain_all()
+            for (seq, req), wait in zip(pending, drain_waits):
                 shard.pool.release(self._need(req))
                 pairs.append((seq, req))
+                waits[seq] = wait
         drops, self._pending_drops = self._pending_drops, {}
         pairs.sort(key=lambda pair: pair[0])
-        out = self._serve_pairs(pairs)
+        out = self._serve_pairs(pairs, waits=waits)
         out.update(drops)
         return [out[seq] for seq in sorted(out)]
 
@@ -640,9 +698,16 @@ class ClusterScheduler:
         re-shed) them."""
         if len(self.shards) < 2:
             return
-        drained = {
-            s.pool.shard_id: s.intake.take_all() for s in self.shards
-        }
+        drained: dict[int, list] = {}
+        stamps: dict[int, list[float]] = {}
+        for s in self.shards:
+            # a re-pack, not a claim: waits stay OFF the histogram;
+            # the (items, stamps) pair is read atomically
+            (
+                drained[s.pool.shard_id],
+                _,
+                stamps[s.pool.shard_id],
+            ) = s.intake.drain_all(record_waits=False)
         if not any(drained.values()):
             return
         # queued commitments come off while we re-pack (in-flight ones,
@@ -653,10 +718,18 @@ class ClusterScheduler:
                 self._need(req) for _, req in drained[shard.pool.shard_id]
             ]
             shard.pool.release(sum(needs[shard.pool.shard_id]))
+        # items re-pack with their ORIGINAL enqueue stamps riding along:
+        # a rebalance must not zero the queue wait the SLO timeline
+        # measures at claim
         final: dict[int, list] = {s.pool.shard_id: [] for s in self.shards}
+        final_stamps: dict[int, list[float]] = {
+            s.pool.shard_id: [] for s in self.shards
+        }
         for shard in self.shards:
             sid = shard.pool.shard_id
-            for item, need in zip(drained[sid], needs[sid]):
+            for (item, stamp), need in zip(
+                zip(drained[sid], stamps[sid]), needs[sid]
+            ):
                 target = shard
                 if shard.pool.free < need:
                     best = self.pool_view.least_pressure()
@@ -667,9 +740,13 @@ class ClusterScheduler:
                             target, "rebalance", need, 0.0, ts
                         )
                 final[target.pool.shard_id].append(item)
+                final_stamps[target.pool.shard_id].append(stamp)
                 target.pool.reserve(need)
         for shard in self.shards:
-            shard.intake.restock(final[shard.pool.shard_id])
+            shard.intake.restock(
+                final[shard.pool.shard_id],
+                enqueued_at=final_stamps[shard.pool.shard_id],
+            )
         self.pool_view.refresh_gauges(self.instruments)
 
     # -- the disaggregated serving loop ----------------------------------
@@ -775,6 +852,11 @@ class ClusterScheduler:
                         )
                 else:
                     served[1] += sum(requests[r].horizon for r in rids)
+                outcome = "deadline_exceeded" if expired else "ok"
+                for s, rid, w in zip(done, rids, widths):
+                    b._emit_req_retire(
+                        rid, s, w + 1, outcome, worker=shard.pool.name
+                    )
 
         while queue or any(r is not None for r in req_of):
             if self.failover is not None:
@@ -833,8 +915,10 @@ class ClusterScheduler:
                 )
                 # adopt into the shard pool + seed the decode carry
                 # (the existing admit phase label — no new histogram
-                # labels; the handoff-specific slices are above)
-                with b._round(span, "admit", requests=1):
+                # labels; the handoff-specific slices are above; the
+                # slot tag lets the timeline layer pin THIS request's
+                # first-token round instead of splitting it)
+                with b._round(span, "admit", requests=1, slot=slot):
                     p_max = chunks_k[0].shape[0]
                     adopt = b._cached_jit(
                         ("cluster_adopt", p_max),

@@ -293,6 +293,12 @@ def health_from_config(config, service) -> HealthServer | None:
             server, lambda: getattr(service, "cluster_scheduler", None)
         )
 
+    if getattr(service, "slo", None) is not None:
+        # SLO-aware degradation: a fast-window burn rate past its
+        # threshold means the fleet is spending error budget faster
+        # than the page-now alert tolerates — /healthz says so
+        add_slo_check(server, lambda: getattr(service, "slo", None))
+
     server.start()
     server.set_ready(True)
     return server
@@ -321,3 +327,24 @@ def add_cluster_check(server: HealthServer, scheduler) -> None:
         return snapshot
 
     server.add_check("cluster", cluster_check)
+
+
+def add_slo_check(server: HealthServer, tracker) -> None:
+    """Register the ``slo`` health check for a
+    :class:`~beholder_tpu.obs.slo.SLOTracker` (or a zero-arg callable
+    resolving to one at probe time — None means "configured but not
+    attached yet", a healthy answer): the check fails (degrading
+    ``/healthz`` to 503) while the FAST-window error-budget burn rate
+    exceeds its threshold — the multi-window pattern's page-now
+    signal — and otherwise returns the burn/attainment detail."""
+
+    def slo_check():
+        target = tracker() if callable(tracker) else tracker
+        if target is None:
+            return "slo configured; no tracker attached"
+        healthy, detail = target.health()
+        if not healthy:
+            raise RuntimeError(detail)
+        return detail
+
+    server.add_check("slo", slo_check)

@@ -339,14 +339,42 @@ _obs_path: str | None = None
 #: would cost as much as the work being measured
 _obs_file = None
 _obs_file_path: str | None = None
+#: size-based rotation: a long-lived daemon's observation log must not
+#: grow without bound. When the log crosses ``_obs_max_bytes`` it
+#: rotates shift-style (path -> path.1 -> ... -> path.N, oldest
+#: dropped); 0/None disables. Defaults come from $METRICS_OBS_ROTATE_*.
+DEFAULT_OBS_ROTATE_BYTES = 64 * 1024 * 1024
+DEFAULT_OBS_ROTATE_KEEP = 3
+_obs_max_bytes: int | None = None
+_obs_keep: int | None = None
+#: resolved (max_bytes, keep) memo — the policy must not cost two env
+#: reads + int() parses per hot-path observation, and a malformed env
+#: value must fall back to the DEFAULT (rotation stays on), never
+#: silently disable the bound the feature exists to enforce
+_obs_policy: tuple[int, int] | None = None
 
 
-def configure_observation_log(path: str | None) -> None:
+def configure_observation_log(
+    path: str | None,
+    max_bytes: int | None = None,
+    keep: int | None = None,
+) -> None:
     """Append raw histogram observations to ``path`` as JSON lines
-    (``None`` reverts to the $METRICS_OBS_JSONL env var / disabled)."""
+    (``None`` reverts to the $METRICS_OBS_JSONL env var / disabled).
+
+    ``max_bytes``/``keep`` override the rotation policy (defaults:
+    $METRICS_OBS_ROTATE_BYTES, 64 MiB / $METRICS_OBS_ROTATE_KEEP, 3
+    rotated files; ``max_bytes=0`` disables rotation). Rotation happens
+    between observations with the cached handle closed first, so it
+    composes with the PR-6 shutdown flush — a SIGTERM mid-window still
+    finds every line on disk in either the live or a rotated file."""
     global _obs_path, _obs_file, _obs_file_path
+    global _obs_max_bytes, _obs_keep, _obs_policy
     with _obs_lock:
         _obs_path = path
+        _obs_max_bytes = max_bytes
+        _obs_keep = keep
+        _obs_policy = None  # re-resolve on next observation
         if _obs_file is not None:
             try:
                 _obs_file.close()
@@ -354,6 +382,51 @@ def configure_observation_log(path: str | None) -> None:
                 pass
         _obs_file = None
         _obs_file_path = None
+
+
+def _obs_rotation_policy() -> tuple[int, int]:
+    """(max_bytes, keep) honoring explicit config then the env —
+    resolved ONCE (memoized until the next configure call). A
+    malformed env value degrades to the default, keeping rotation
+    armed: silently unbounded growth is the bug this exists to fix."""
+    global _obs_policy
+    policy = _obs_policy
+    if policy is not None:
+        return policy
+
+    def _env_int(name: str, default: int) -> int:
+        try:
+            return int(os.environ.get(name, default))
+        except (TypeError, ValueError):
+            return default
+
+    max_bytes = _obs_max_bytes
+    if max_bytes is None:
+        max_bytes = _env_int(
+            "METRICS_OBS_ROTATE_BYTES", DEFAULT_OBS_ROTATE_BYTES
+        )
+    keep = _obs_keep
+    if keep is None:
+        keep = _env_int(
+            "METRICS_OBS_ROTATE_KEEP", DEFAULT_OBS_ROTATE_KEEP
+        )
+    _obs_policy = (max_bytes, max(1, keep))
+    return _obs_policy
+
+
+def _rotate_observation_log_locked(path: str, keep: int) -> None:
+    """Shift-rotate ``path`` (caller holds ``_obs_lock`` with the
+    cached handle already closed): path.(keep) drops, path.i ->
+    path.(i+1), path -> path.1. Best-effort — a failed rename must not
+    kill the hot path (the caller's except covers it)."""
+    oldest = f"{path}.{keep}"
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for i in range(keep - 1, 0, -1):
+        src = f"{path}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i + 1}")
+    os.replace(path, f"{path}.1")
 
 
 def flush_observation_log() -> None:
@@ -400,6 +473,15 @@ def _observation_record(
                 _obs_file_path = path
             _obs_file.write(line + "\n")
             _obs_file.flush()
+            # size-based rotation: close + shift when the live file
+            # crosses the cap, so a week-long daemon holds at most
+            # (keep + 1) bounded files instead of one unbounded log
+            max_bytes, keep = _obs_rotation_policy()
+            if max_bytes and _obs_file.tell() >= max_bytes:
+                _obs_file.close()
+                _obs_file = None
+                _obs_file_path = None
+                _rotate_observation_log_locked(path, keep)
     except Exception:  # noqa: BLE001 - a broken sink must not kill hot paths
         pass
 
@@ -484,6 +566,21 @@ class Metrics:
             "Total trello comments crreated in this processes lifetime",
         )
         self._server: ThreadingHTTPServer | None = None
+        #: extra endpoints riding the metrics server (``/slo``,
+        #: ``/debug/flight``): registered before OR after expose() —
+        #: the handler resolves routes per request off the live dict
+        self._routes: dict | None = None
+        self._extra_routes: dict = {}
+
+    def add_route(self, path: str, route) -> None:
+        """Serve ``route`` (an httpd Route callable) at ``path`` on the
+        metrics server. Safe before or after :meth:`expose` — the
+        request handler looks paths up per request, so a route added to
+        a live server takes effect immediately. The default route set
+        (and the /metrics exposition itself) is untouched."""
+        self._extra_routes[path] = route
+        if self._routes is not None:
+            self._routes[path] = route
 
     def expose(
         self, port: int | None = None, cache_max_age_s: float | None = None
@@ -512,7 +609,9 @@ class Metrics:
             from beholder_tpu.httpd import CachedRoute
 
             route = CachedRoute(render, cache_max_age_s)
-        self._server = serve_routes({"/metrics": route, "/": route}, port)
+        self._routes = {"/metrics": route, "/": route}
+        self._routes.update(self._extra_routes)
+        self._server = serve_routes(self._routes, port)
         return self._server.server_address[1]
 
     def close(self) -> None:

@@ -1445,6 +1445,15 @@ class ContinuousBatcher:
         #: catalog's counter — registering it eagerly would widen the
         #: pinned default exposition for every batcher with metrics)
         self._deadline_counter = None
+        #: per-request timeline annotations for the NEXT scheduler call
+        #: (:meth:`annotate_requests` — rid -> {gid, queue_wait_s});
+        #: consumed by ``_start_run`` into ``_run_notes``, which the
+        #: recorder-only ``req.claim``/``req.retire`` instants merge so
+        #: the SLO timeline layer can key requests across cluster
+        #: routing and failover recovery legs. Empty dicts cost nothing
+        #: and, recorder-off, neither is ever read.
+        self._timeline_notes: dict[int, dict] = {}
+        self._run_notes: dict[int, dict] = {}
 
     # -- shared helpers -------------------------------------------------
 
@@ -1616,7 +1625,8 @@ class ContinuousBatcher:
                     if fr is not None:
                         fr.instant(
                             "deadline_exceeded", trace_id=claim_tid,
-                            stage="claim",
+                            stage="claim", rid=rid,
+                            **self._run_notes.get(rid, {}),
                         )
                     continue
                 self._check_servable(req)
@@ -1666,6 +1676,17 @@ class ContinuousBatcher:
                 batch.append((slot, rid, feats_np, t, hit_pages, hashes))
                 req_of[slot] = rid
                 commit(slot, rid, req, need)
+                if fr is not None:
+                    # the request-level lifecycle marker the SLO/
+                    # timeline layer folds (obs/timeline.py): claim
+                    # time anchors queue-wait and TTFT
+                    fr.instant(
+                        "req.claim", trace_id=claim_tid, rid=rid,
+                        slot=slot, prefix_tokens=int(t),
+                        hit_pages=len(hit_pages),
+                        horizon=int(req.horizon),
+                        **self._run_notes.get(rid, {}),
+                    )
         finally:
             if fr is not None:
                 fr.record(
@@ -1752,6 +1773,10 @@ class ContinuousBatcher:
         batcher — the host's free-page arithmetic would no longer mirror
         the device allocator — and every later call refuses to run."""
         self._check_not_poisoned()
+        # timeline annotations apply to exactly one scheduler call: the
+        # one whose requests they index (set by run_pending / the
+        # cluster router immediately before the call)
+        self._run_notes, self._timeline_notes = self._timeline_notes, {}
         for req in requests:
             if req.horizon <= 0:
                 continue
@@ -1761,6 +1786,36 @@ class ContinuousBatcher:
                     f"prefix {t} exceeds max_prefix {self.max_prefix}"
                 )
             self._check_servable(req)
+
+    def _emit_req_retire(
+        self, rid: int, slot: int, tokens: int, outcome: str = "ok",
+        **extra,
+    ) -> None:
+        """ONE copy of the ``req.retire`` lifecycle instant all four
+        serving loops emit (run's retire_many, the fused wave release,
+        the spec loop's retire, the disagg loop's retire_many) — the
+        SLO/timeline fold keys on this exact event shape, so its
+        contract must not be able to drift between loops. ``extra``
+        seeds defaults (e.g. the disagg lane's ``worker=``); the
+        caller-set timeline notes win on collision."""
+        fr = self.flight_recorder
+        if fr is None:
+            return
+        note = {**extra, **self._run_notes.get(rid, {})}
+        fr.instant(
+            "req.retire", rid=rid, slot=slot, tokens=int(tokens),
+            outcome=outcome, **note,
+        )
+
+    def annotate_requests(self, notes: dict[int, dict]) -> None:
+        """Attach per-request timeline annotations to the NEXT scheduler
+        call: ``notes[rid]`` merges into that request's recorder-only
+        ``req.claim``/``req.retire`` instants (keys: ``gid`` — a
+        caller-global request id, stable across failover recovery
+        legs — and ``queue_wait_s``, the intake residency the SLO layer
+        folds into queue-wait). Purely observational: with no flight
+        recorder armed the notes are never read."""
+        self._timeline_notes = dict(notes)
 
     # -- admission control: bounded intake + shed -----------------------
 
@@ -1798,9 +1853,17 @@ class ContinuousBatcher:
         way (``waves=False`` still picks spec when configured)."""
         if self.intake is None:
             raise RuntimeError("no intake queue configured")
-        pending = self.intake.take_all()
+        pending, waits, _ = self.intake.drain_all()
         if not pending:
             return []
+        if self.flight_recorder is not None:
+            # intake residency (measured at the drain, read atomically
+            # with the items) rides the timeline: the SLO layer's
+            # queue-wait is measured, not inferred
+            self.annotate_requests({
+                rid: {"queue_wait_s": round(wait, 6)}
+                for rid, wait in enumerate(waits)
+            })
         if waves is None:
             waves = self.prefix_cache is None and self.spec is None
         if waves:
@@ -1968,6 +2031,9 @@ class ContinuousBatcher:
                         )
                 else:
                     served[1] += sum(requests[r].horizon for r in rids)
+                outcome = "deadline_exceeded" if expired else "ok"
+                for s, rid, w in zip(done, rids, widths):
+                    self._emit_req_retire(rid, s, w + 1, outcome)
 
         while queue or any(r is not None for r in req_of):
             if has_deadlines:
@@ -2266,6 +2332,16 @@ class ContinuousBatcher:
                 queue.pop(0)
                 wave.append((rid, req))
                 horizon = h
+                if self.flight_recorder is not None:
+                    # the fused path's lifecycle marker: claim = wave
+                    # membership (the wave slice that follows is the
+                    # request's admission AND its first token)
+                    self.flight_recorder.instant(
+                        "req.claim", rid=rid, slot=len(wave) - 1,
+                        prefix_tokens=len(req.progress) - 1,
+                        horizon=int(req.horizon),
+                        **self._run_notes.get(rid, {}),
+                    )
             if not wave:
                 continue
 
@@ -2303,6 +2379,12 @@ class ContinuousBatcher:
                     jnp.asarray(lens), jnp.asarray(stats),
                 )
                 batches.append((wave, deltas))
+            # retire = the fused program released the wave's slots
+            # (run()'s retire semantics — pre-readback; the end-of-run
+            # readback wall is charged to these requests by the
+            # timeline fold's delivery rule)
+            for slot_i, (rid, req) in enumerate(wave):
+                self._emit_req_retire(rid, slot_i, req.horizon)
             if self._metrics:
                 # the most recently DISPATCHED wave's occupancy (dispatch
                 # is async; the device drains waves behind the loop).

@@ -24,15 +24,31 @@ from .roofline import (
     attribution_summary,
     model_flops_per_token,
 )
+from .slo import (
+    LatencyDigest,
+    P2Quantile,
+    SLOConfig,
+    SLOTracker,
+    slo_from_config,
+)
+from .timeline import RequestTimeline, TimelineReport, build_timelines
 
 __all__ = [
     "DEFAULT_RING_SIZE",
     "FlightRecorder",
+    "LatencyDigest",
+    "P2Quantile",
     "PHASE_FAMILIES",
+    "RequestTimeline",
     "RooflineAttributor",
+    "SLOConfig",
+    "SLOTracker",
+    "TimelineReport",
     "attribution_summary",
+    "build_timelines",
     "flight_recorder_from_config",
     "model_flops_per_token",
+    "slo_from_config",
 ]
 
 

@@ -75,6 +75,17 @@ class FlightRecorder:
         self.dropped = 0
         self._ring: deque[dict[str, Any]] = deque(maxlen=ring_size)
         self._lock = threading.Lock()
+        #: event listeners (e.g. the SLO tracker's streaming fold):
+        #: called with each event AFTER it lands in the ring, outside
+        #: the ring lock; a listener that raises is swallowed — the
+        #: observability layer must never take serving down with it
+        self._listeners: list = []
+
+    def add_listener(self, listener) -> None:
+        """Subscribe ``listener(event_dict)`` to every recorded event —
+        the live-consumption hook (the SLO tracker folds request
+        lifecycles from it without waiting for a ring export)."""
+        self._listeners.append(listener)
 
     # -- recording -------------------------------------------------------
 
@@ -140,6 +151,11 @@ class FlightRecorder:
             if len(self._ring) == self.ring_size:
                 self.dropped += 1
             self._ring.append(event)
+        for listener in self._listeners:
+            try:
+                listener(event)
+            except Exception:  # noqa: BLE001 - observers must not kill serving
+                pass
 
     # -- introspection / export -----------------------------------------
 
@@ -157,6 +173,14 @@ class FlightRecorder:
             self._ring.clear()
             self.dropped = 0
 
+    def jsonl(self) -> str:
+        """The current ring serialized as JSON lines (one event per
+        line) — the shared rendering behind :meth:`dump` and the live
+        ``GET /debug/flight`` endpoint."""
+        return "".join(
+            json.dumps(event, default=str) + "\n" for event in self.events()
+        )
+
     def dump(self, path: str | None = None) -> str:
         """Write the ring as JSON lines (one event per line) to ``path``
         (default: ``export_path``); returns the path written. The
@@ -165,8 +189,17 @@ class FlightRecorder:
         path = path or self.export_path
         if not path:
             raise ValueError("no path given and no export_path configured")
-        events = self.events()
         with open(path, "w") as f:
-            for event in events:
-                f.write(json.dumps(event, default=str) + "\n")
+            f.write(self.jsonl())
         return path
+
+    def route(self):
+        """An httpd Route serving the LIVE ring as JSONL — the
+        ``GET /debug/flight`` endpoint (wired by ``service.init`` when
+        the recorder knob is on), so an operator can inspect the
+        timeline without waiting for the SIGTERM export."""
+
+        def flight_route():
+            return 200, "application/x-ndjson", self.jsonl().encode()
+
+        return flight_route

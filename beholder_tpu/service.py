@@ -232,6 +232,23 @@ class BeholderService:
 
         self.flight_recorder = flight_recorder_from_config(config)
 
+        #: optional request-level SLO engine (``instance.slo.*``; OFF
+        #: by default ⇒ serving output and the default exposition stay
+        #: byte-identical, same contract as cache/spec/cluster). The
+        #: tracker folds the flight recorder's per-request lifecycle
+        #: events into streaming TTFT/TPOT digests and multi-window
+        #: error-budget burn rates: /healthz gains the ``slo`` check
+        #: (degraded past the fast-window burn threshold), the metrics
+        #: server gains ``GET /slo``, and the beholder_slo_* catalog
+        #: registers. Import-light (no jax) like the other knobs.
+        from beholder_tpu.obs.slo import slo_from_config
+
+        self.slo = slo_from_config(config, registry=self.metrics.registry)
+        if self.slo is not None and self.flight_recorder is not None:
+            # the daemon feed: req.claim/req.retire/req.recovered
+            # instants stream into the tracker as they are recorded
+            self.flight_recorder.add_listener(self.slo.on_event)
+
         #: optional cluster serving (``instance.cluster.*``; OFF by
         #: default). A library knob like ``spec``: the service parses
         #: it once into a :class:`beholder_tpu.cluster.ClusterConfig`
@@ -626,6 +643,18 @@ def init(
 
         service = BeholderService(config, broker, db, metrics=metrics)
         service.start()
+
+        #: operator endpoints riding the metrics server (both gated on
+        #: their knobs, so the default server stays /metrics-only):
+        #: GET /slo renders attainment + budget burn, GET /debug/flight
+        #: dumps the LIVE recorder ring as JSONL — no more waiting for
+        #: the SIGTERM export to see the timeline
+        if service.slo is not None:
+            metrics.add_route("/slo", service.slo.route())
+        if service.flight_recorder is not None:
+            metrics.add_route(
+                "/debug/flight", service.flight_recorder.route()
+            )
 
         #: optional /healthz + /readyz endpoint (extension; the reference
         #: delegates failure detection to its container orchestrator)

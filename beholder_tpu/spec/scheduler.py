@@ -263,6 +263,7 @@ def _run_spec_loop(
                 results[rid] = np.asarray(
                     emitted[s][: requests[rid].horizon], np.float32
                 )
+                batcher._emit_req_retire(rid, s, requests[rid].horizon)
                 served[0] += 1
                 served[1] += requests[rid].horizon
                 req_of[s] = None

@@ -22,6 +22,11 @@ metric                      why it survives host drift                fails
                             (schema-v5 attribution) — shape of the
                             step, not its speed
 ``stall_pct``               % of recorded wall spent waiting          higher
+``ttft_tail_ratio``         p95/p50 TTFT from the same run's SLO      higher
+                            digests — distribution shape, host
+                            speed divides out
+``slo_attainment``          fraction of requests inside every         lower
+                            latency objective — request accounting
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -83,6 +88,20 @@ NOISE_BANDS: dict[str, float] = {
     # itself: a regression is the recovery path getting materially
     # slower relative to its own committed baseline
     "failover_recovery_overhead_ratio": 0.50,
+    # p95/p50 TTFT from the SLO digests (schema v8): both quantiles
+    # come from the SAME run, so host speed divides out — the ratio is
+    # the SHAPE of the latency distribution. A tail regression (one
+    # request class stalling while the median holds) moves it where no
+    # throughput ratio looks. Tails are the noisiest structural signal
+    # here (a single straggler moves p95 on a 10-60-request bench), so
+    # the band is the widest in the table — what it must catch is the
+    # tail DETACHING from the median, not jitter around it
+    "ttft_tail_ratio": 0.75,
+    # fraction of requests inside every latency objective — pure
+    # request accounting against objectives evaluated in-run; the
+    # committed baseline's objectives are sized so healthy CI runs sit
+    # at/near 1.0, making any material drop a real scheduling change
+    "slo_attainment": 0.10,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -147,6 +166,32 @@ def _failover_recovery_ratio(artifact: dict) -> float | None:
     return float(value)
 
 
+def _ttft_tail_ratio(artifact: dict) -> float | None:
+    p50 = _get(artifact, "slo", "ttft_p50_ms")
+    p95 = _get(artifact, "slo", "ttft_p95_ms")
+    if (
+        not isinstance(p50, (int, float))
+        or not isinstance(p95, (int, float))
+        or p50 <= 0
+        or p95 <= 0
+    ):
+        return None  # pre-v8 artifact / slo scenario not run
+    return float(p95) / float(p50)
+
+
+def _slo_attainment(artifact: dict) -> float | None:
+    value = _get(artifact, "slo", "attainment")
+    if not isinstance(value, (int, float)):
+        return None
+    # "scenario not run" (the empty v8 block) is distinguished by the
+    # digest, not by attainment itself — a genuine 0% attainment (every
+    # request bad) must still hit the gate, not silently skip it
+    ttft = _get(artifact, "slo", "ttft_p50_ms")
+    if not isinstance(ttft, (int, float)) or ttft <= 0:
+        return None  # no request was ever digested: slo scenario absent
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -162,6 +207,10 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # shows as the ratio RISING
     ("failover_recovery_overhead_ratio", _failover_recovery_ratio,
      "higher"),
+    # p95/p50 TTFT: a latency-tail regression shows as the ratio RISING
+    ("ttft_tail_ratio", _ttft_tail_ratio, "higher"),
+    # objective attainment: degradation is the fraction FALLING
+    ("slo_attainment", _slo_attainment, "lower"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -194,6 +243,10 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
             a, "sections", "failover", "result", "recovery_latency_ms"
         ),
     ),
+    # absolute SLO milliseconds: host-speed-dependent, reported only
+    # (the gated figures are the tail ratio and attainment above)
+    ("slo_ttft_p50_ms", lambda a: _get(a, "slo", "ttft_p50_ms")),
+    ("slo_tpot_p50_ms", lambda a: _get(a, "slo", "tpot_p50_ms")),
 ]
 
 

@@ -1546,6 +1546,125 @@ def bench_failover() -> dict:
     }
 
 
+def bench_slo() -> dict:
+    """The request-level SLO engine, measured on a live serving run:
+    a decode-heavy request mix rides the bounded intake
+    (``submit``/``run_pending``) with the flight recorder armed and an
+    :class:`~beholder_tpu.obs.slo.SLOTracker` attached as a recorder
+    listener — exactly the daemon wiring — so the artifact's schema-v8
+    ``slo`` block carries LIVE streaming TTFT/TPOT digests, attainment
+    and the worst request, and the per-request timelines are rebuilt
+    from the same ring as evidence that the fold reconciles with the
+    recorder wall.
+
+    The perf gate bands two figures from this scenario: the p95/p50
+    TTFT tail ratio (distribution shape — host speed divides out) and
+    attainment (request accounting against objectives evaluated
+    in-run); absolute milliseconds are reported, never gated
+    (BENCH_NOTES drift doctrine). Objectives are sized so a healthy
+    run attains 1.0 — the gate catches scheduling-shape changes, not
+    host weather. CPU-sized like the cache/spec scenarios so every
+    bench tier (incl. BENCH_QUICK) carries live digests."""
+    import jax
+    import numpy as np
+
+    from beholder_tpu import metrics as metrics_mod
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+    from beholder_tpu.models.serving import ContinuousBatcher, Request
+    from beholder_tpu.obs import (
+        FlightRecorder,
+        SLOConfig,
+        SLOTracker,
+        build_timelines,
+    )
+    from beholder_tpu.proto import TelemetryStatusEntry
+
+    page, slots = 8, 4
+    prefix_t, horizon = 16, 48
+    n_requests = 12
+    model = TelemetrySequenceModel(dim=64, heads=4, kv_heads=2, layers=2)
+    state, _, _ = init_seq_state(
+        jax.random.PRNGKey(0), prefix_t, model=model
+    )
+
+    def mk_request(seed):
+        r = np.random.default_rng(1100 + seed)
+        prog = np.cumsum(1.0 + r.normal(0, 0.05, prefix_t + 1))
+        stats = np.full(len(prog), int(TelemetryStatusEntry.CONVERTING))
+        return Request(prog, stats, horizon)
+
+    registry = metrics_mod.Registry()
+    recorder = FlightRecorder(ring_size=8192)
+    batcher = ContinuousBatcher(
+        model, state.params,
+        num_pages=128, page_size=page, slots=slots,
+        max_prefix=prefix_t, max_pages_per_seq=16,
+        metrics=registry, flight_recorder=recorder, max_pending=64,
+    )
+    # warm the jits first, then clear the ring and attach the tracker:
+    # the committed digests must describe steady-state scheduling, not
+    # compile order (the same discipline as the cluster bench)
+    batcher.run([mk_request(900 + i) for i in range(slots)])
+    recorder.clear()
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=30_000.0, tpot_ms=1_000.0, target=0.99),
+        registry=registry,
+    )
+    recorder.add_listener(tracker.on_event)
+
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        admission = batcher.submit(mk_request(i))
+        assert admission.accepted, admission
+    batcher.run_pending(waves=False)
+    wall_s = time.perf_counter() - t0
+
+    summary = tracker.artifact_summary()
+    artifact.record_slo(summary)
+    artifact.record_raw(
+        "serving.slo_mix", "trial_wall", [wall_s],
+        requests=n_requests, tokens=n_requests * horizon,
+    )
+
+    # offline reconciliation: the timeline fold over the same ring must
+    # hand every request a lifecycle and conserve the recorder wall
+    report = build_timelines(recorder.events())
+    complete = [t for t in report.timelines if t.ttft_s is not None]
+    snapshot = tracker.snapshot()
+    tail_ratio = (
+        summary["ttft_p95_ms"] / summary["ttft_p50_ms"]
+        if summary["ttft_p50_ms"]
+        else 0.0
+    )
+    return {
+        "metric": "slo_ttft_tail_ratio",
+        "value": round(tail_ratio, 4),
+        "ttft_p50_ms": summary["ttft_p50_ms"],
+        "ttft_p95_ms": summary["ttft_p95_ms"],
+        "tpot_p50_ms": summary["tpot_p50_ms"],
+        "attainment": summary["attainment"],
+        "worst_request": summary["worst_request"],
+        "burn_rate_fast": snapshot["burn_rate"]["fast"],
+        "queue_wait_ms": snapshot["queue_wait_ms"],
+        "timelines": len(report.timelines),
+        "timelines_complete": len(complete),
+        "wall_attributed_pct": round(
+            100.0 * report.attributed_s / report.wall_s, 2
+        ) if report.wall_s else 0.0,
+        "requests": n_requests,
+        "note": (
+            f"{n_requests} x ({prefix_t}-prefix + {horizon}-horizon) "
+            "decode-heavy mix through submit/run_pending with the "
+            "flight recorder armed and the SLO tracker attached as a "
+            "recorder listener (the daemon wiring); jits warmed first, "
+            "ring cleared, so digests describe steady-state rounds. "
+            "value = p95/p50 TTFT from the streaming P2 digests — the "
+            "environment-normalized shape figure the perf gate bands, "
+            "with attainment; absolute ms are reported, never gated."
+        ),
+    }
+
+
 def bench_serving_multiwave() -> dict:
     """The workload paging exists for: a request POPULATION (48) much
     bigger than the slot count (8), ragged lengths (40 short
@@ -1976,6 +2095,9 @@ def _e2e_main(rec: artifact.ArtifactRecorder) -> None:
     # failover counters (recoveries > 0 is the CI acceptance gate) and
     # the recovery-overhead ratio
     secondary["failover"] = rec.section("failover", bench_failover())
+    # and the v8 slo block: live streaming TTFT/TPOT digests from a
+    # recorder-fed tracker (ttft_p50_ms > 0 is the CI acceptance gate)
+    secondary["slo"] = rec.section("slo", bench_slo())
     print(
         json.dumps(
             {
@@ -2028,6 +2150,13 @@ def _failover_main(rec: artifact.ArtifactRecorder) -> None:
     print(json.dumps(result))
 
 
+def _slo_main(rec: artifact.ArtifactRecorder) -> None:
+    """``make bench-slo``: just the recorder-fed SLO scenario — live
+    TTFT/TPOT digests, attainment, and the timeline reconciliation."""
+    result = rec.section("slo", bench_slo())
+    print(json.dumps(result))
+
+
 def main() -> None:
     import sys
 
@@ -2036,6 +2165,7 @@ def main() -> None:
     spec_only = "--spec-only" in sys.argv
     cluster_only = "--cluster-only" in sys.argv
     failover_only = "--failover-only" in sys.argv
+    slo_only = "--slo-only" in sys.argv
     # EVERY bench run leaves a schema-versioned raw artifact behind —
     # including error and skip outcomes (VERDICT round-5 "What's
     # missing" item 1: perf claims need committed raw files, not prose)
@@ -2045,6 +2175,7 @@ def main() -> None:
         else "bench_spec" if spec_only
         else "bench_cluster" if cluster_only
         else "bench_failover" if failover_only
+        else "bench_slo" if slo_only
         else "bench_e2e"
     )
     rec.sections["config"] = {
@@ -2062,6 +2193,8 @@ def main() -> None:
             _cluster_main(rec)
         elif failover_only:
             _failover_main(rec)
+        elif slo_only:
+            _slo_main(rec)
         else:
             _e2e_main(rec)
     except BaseException as err:

@@ -1,0 +1,992 @@
+"""Request-level SLO engine (ISSUE 9): timeline reconstruction from a
+recorded ring (single-engine, spec, disaggregated, and a
+failover-recovery leg), streaming digest accuracy and boundedness,
+burn-rate window math, the default-OFF exposition pin, the /slo +
+degraded-healthz legs, the intake wait histogram, observation-log
+rotation, the live /debug/flight route, artifact schema v8, and the
+perf-gate bands."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.metrics import Metrics, Registry
+from beholder_tpu.obs import (
+    FlightRecorder,
+    LatencyDigest,
+    P2Quantile,
+    SLOConfig,
+    SLOTracker,
+    build_timelines,
+    slo_from_config,
+)
+
+pytestmark = pytest.mark.slo
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+def _request(seed, t=9, horizon=6):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+    )
+
+
+BATCHER_KW = dict(
+    num_pages=16, page_size=8, slots=2, max_prefix=16, max_pages_per_seq=4
+)
+
+
+def _mk_batcher(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ContinuousBatcher(model, state.params, **kw)
+
+
+def _reconciled(report):
+    assert report.wall_s > 0
+    assert abs(
+        report.attributed_s + report.unattributed_s - report.wall_s
+    ) < 1e-6
+    return report
+
+
+# -- streaming digests -------------------------------------------------------
+
+
+def test_p2_digest_accuracy_vs_exact_quantiles():
+    """The acceptance accuracy check: P2 estimates on a fixed 10k
+    sample track exact quantiles within a few percent (uniform and a
+    skewed lognormal — the latencies digests actually see)."""
+    rng = np.random.default_rng(7)
+    for samples, tol in (
+        (rng.uniform(0.0, 1.0, 10_000), 0.02),
+        (rng.lognormal(0.0, 0.5, 10_000), 0.05),
+    ):
+        digest = LatencyDigest()
+        for x in samples:
+            digest.observe(float(x))
+        for q in (0.5, 0.95, 0.99):
+            exact = float(np.quantile(samples, q))
+            got = digest.quantile(q)
+            assert abs(got - exact) <= tol * max(exact, 1e-9), (q, got, exact)
+        assert digest.count == len(samples)
+        assert digest.max == pytest.approx(float(samples.max()))
+
+
+def test_p2_quantile_validates_and_handles_few_samples():
+    with pytest.raises(ValueError, match="quantile"):
+        P2Quantile(1.5)
+    est = P2Quantile(0.5)
+    assert est.value() == 0.0  # nothing observed yet
+    for x in (3.0, 1.0, 2.0):
+        est.observe(x)
+    assert est.value() == 2.0  # exact over the pre-marker samples
+
+
+def test_digests_and_tracker_stay_bounded_under_10k_requests():
+    """The acceptance memory bound: 10k+ synthetic requests leave the
+    tracker holding five markers per quantile, ~30 window buckets, and
+    an empty open table — constant memory, like the recorder ring."""
+    clock = [0.0]
+    tracker = SLOTracker(SLOConfig(), clock=lambda: clock[0])
+    for i in range(10_500):
+        clock[0] += 0.01
+        tracker.observe(
+            ttft_s=0.001 + (i % 7) * 1e-4,
+            tpot_s=1e-4,
+            worker=f"decode-{i % 2}",
+            key=i,
+        )
+    assert tracker.good + tracker.bad == 10_500
+    for scope_digests in tracker._digests.values():
+        for digest in scope_digests.values():
+            for est in digest._quantiles.values():
+                assert est._heights is None or len(est._heights) == 5
+                assert len(est._first) <= 5
+    for window in tracker._windows.values():
+        assert len(window._buckets) <= 31
+    assert len(tracker._open) == 0  # direct observe never opens entries
+    assert len(tracker._digests) == 3  # cluster + the two workers
+
+
+def test_open_request_table_is_bounded():
+    tracker = SLOTracker(SLOConfig())
+    for i in range(SLOTracker.MAX_OPEN + 50):
+        tracker.on_event(
+            {"name": "req.claim", "ts_us": i, "trace_id": "t",
+             "args": {"rid": i}}
+        )
+    assert len(tracker._open) == SLOTracker.MAX_OPEN
+    assert tracker.dropped_open == 50
+
+
+# -- burn-rate window math ---------------------------------------------------
+
+
+def test_burn_rate_multi_window_math():
+    """Deterministic clock: burn = bad_fraction / error_budget per
+    window; the fast window forgets, the slow window remembers."""
+    clock = [1000.0]
+    cfg = SLOConfig(target=0.9, fast_window_s=60.0, slow_window_s=600.0)
+    tracker = SLOTracker(cfg, clock=lambda: clock[0])
+    for i in range(8):
+        tracker.observe(ttft_s=0.001, key=f"good-{i}")
+    for i in range(2):
+        tracker.observe(ttft_s=10.0, key=f"bad-{i}")  # ttft objective blown
+    # 2/10 bad over a 0.1 budget -> burn 2.0 on both windows
+    assert tracker.burn_rate("fast") == pytest.approx(2.0)
+    assert tracker.burn_rate("slow") == pytest.approx(2.0)
+    assert tracker.attainment() == pytest.approx(0.8)
+    assert tracker.budget_remaining() == pytest.approx(-1.0)
+    # 2 minutes later the fast window has forgotten, the slow has not
+    clock[0] += 120.0
+    assert tracker.burn_rate("fast") == 0.0
+    assert tracker.burn_rate("slow") == pytest.approx(2.0)
+    # and past the slow window everything ages out
+    clock[0] += 700.0
+    assert tracker.burn_rate("slow") == 0.0
+    assert tracker.attainment() == pytest.approx(0.8)  # lifetime stays
+
+
+def test_verdict_classification_and_worst_request():
+    tracker = SLOTracker(SLOConfig(ttft_ms=100.0, tpot_ms=10.0))
+    assert tracker.observe(ttft_s=0.05, tpot_s=0.005, key="a") is True
+    assert tracker.observe(ttft_s=0.05, tpot_s=0.5, key="b") is False
+    assert tracker.observe(ttft_s=0.05, outcome="deadline_exceeded",
+                           key="c") is False
+    assert tracker.observe(ttft_s=0.2, key="worst") is False
+    assert tracker.worst_request["key"] == "worst"
+    assert tracker.worst_request["ttft_ms"] == pytest.approx(200.0)
+
+
+# -- timeline reconstruction: single engine ----------------------------------
+
+
+def test_timeline_single_engine_with_queue_wait(model_state):
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    batcher = _mk_batcher(
+        model, state, flight_recorder=fr, max_pending=8
+    )
+    reqs = [_request(i, horizon=6) for i in range(4)]
+    for req in reqs:
+        assert batcher.submit(req).accepted
+    time.sleep(0.005)  # measurable intake residency
+    results = batcher.run_pending(waves=False)
+    assert len(results) == 4
+
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 4
+    for timeline in report.timelines:
+        assert timeline.outcome == "ok"
+        assert timeline.tokens == 6
+        assert timeline.horizon == 6
+        assert timeline.ttft_s is not None and timeline.ttft_s > 0
+        assert timeline.tpot_s is not None and timeline.tpot_s >= 0
+        assert timeline.queue_wait_s > 0  # measured at intake drain
+        assert timeline.wall_s >= timeline.ttft_s
+        assert timeline.phases  # tick/admit wall attributed
+    # the request's phase attribution is dominated by real phases
+    phases = set()
+    for timeline in report.timelines:
+        phases |= set(timeline.phases)
+    assert {"claim", "admit", "tick", "retire"} <= phases
+    # splitting conserves: total attributed equals the sum over records
+    total = sum(
+        sum(t.phases.values()) for t in report.timelines
+    )
+    assert total == pytest.approx(report.attributed_s, abs=1e-6)
+
+
+def test_timeline_phase_sums_match_recorder_wall(model_state):
+    """The acceptance reconciliation: per-request phase sums + the
+    unattributed remainder reproduce the recorder wall exactly, and
+    with requests in flight the attributed share dominates."""
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+    batcher.run([_request(i, horizon=7) for i in range(4)])
+    report = _reconciled(build_timelines(fr.events()))
+    assert report.attributed_s / report.wall_s > 0.5
+
+
+def test_timeline_spec_run(model_state):
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    batcher = _mk_batcher(
+        model, state, flight_recorder=fr,
+        spec=SpecConfig(max_draft=2, accept_tol=0.0),
+    )
+    batcher.run_spec([_request(i, horizon=6) for i in range(3)])
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 3
+    for timeline in report.timelines:
+        assert timeline.outcome == "ok"
+        assert timeline.tokens == 6
+        assert timeline.ttft_s is not None and timeline.ttft_s > 0
+    phases = set().union(*(t.phases for t in report.timelines))
+    assert {"draft", "verify", "rollback"} <= phases
+
+
+def test_timeline_deadline_outcome(model_state):
+    model, state = model_state
+    fr = FlightRecorder(ring_size=1024)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+
+    class _Lapsing:
+        """Passes the claim-time check, expires at the next sweep."""
+
+        def __init__(self):
+            self.checks = 0
+
+        @property
+        def expired(self):
+            self.checks += 1
+            return self.checks > 1
+
+    from beholder_tpu.models.serving import (
+        DeadlineExceededResult,
+        Request,
+    )
+
+    base = _request(1, horizon=12)
+    lapsing = Request(base.progress, base.statuses, 12, _Lapsing())
+    # the short request's retirement creates the scheduling-event
+    # boundary at which the survivor's deadline sweep fires
+    out = batcher.run([_request(0, horizon=3), lapsing])
+    assert isinstance(out[1], DeadlineExceededResult)
+    report = _reconciled(build_timelines(fr.events()))
+    by_outcome = {t.outcome: t for t in report.timelines}
+    assert set(by_outcome) == {"ok", "deadline_exceeded"}
+    expired = by_outcome["deadline_exceeded"]
+    assert 1 <= expired.tokens < 12  # the partial stream is on record
+    assert expired.ttft_s is not None
+
+
+def test_timeline_and_tracker_cover_the_fused_wave_path(model_state):
+    """run_pending's DEFAULT (waves) scheduler must feed the SLO layer
+    too: wave membership claims, the fused program's slice is
+    first-token, release retires — a plainly-configured daemon is not
+    silently uninstrumented."""
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    tracker = SLOTracker(SLOConfig(ttft_ms=60_000.0, tpot_ms=60_000.0))
+    fr.add_listener(tracker.on_event)
+    batcher = _mk_batcher(model, state, flight_recorder=fr, max_pending=8)
+    for i in range(3):
+        assert batcher.submit(_request(i, horizon=5)).accepted
+    time.sleep(0.005)
+    results = batcher.run_pending()  # waves by default
+    assert len(results) == 3
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 3
+    for timeline in report.timelines:
+        assert timeline.outcome == "ok"
+        assert timeline.tokens == 5
+        assert timeline.ttft_s is not None and timeline.ttft_s > 0
+        assert timeline.queue_wait_s >= 0.005
+        assert "wave" in timeline.phases
+    assert tracker.good + tracker.bad == 3
+
+
+def test_claim_stage_deadline_reaches_tracker_and_timeline(model_state):
+    """A request expiring IN QUEUE (the recovery-storm overload mode)
+    must count as a bad outcome — and must never rewrite a completed
+    same-key record from an earlier run."""
+    from beholder_tpu.models.serving import (
+        DeadlineExceededResult,
+        Request,
+    )
+    from beholder_tpu.reliability.policy import Deadline
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=2048)
+    # latency objectives generous (the cold run pays jit compiles);
+    # what this test exercises is the OUTCOME classification
+    tracker = SLOTracker(
+        SLOConfig(target=0.9, ttft_ms=60_000.0, tpot_ms=60_000.0)
+    )
+    fr.add_listener(tracker.on_event)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+    # run 1: rid 0 completes normally
+    batcher.run([_request(0, horizon=4)])
+    # run 2: rid 0 is already expired at claim — zero tokens, explicit
+    base = _request(1, horizon=4)
+    out = batcher.run(
+        [Request(base.progress, base.statuses, 4, Deadline.after(-1.0))]
+    )
+    assert isinstance(out[0], DeadlineExceededResult)
+    assert tracker.bad == 1 and tracker.good == 1
+    assert tracker.burn_rate("fast") > 1.0  # 1 bad of 2 over 0.1 budget
+    report = build_timelines(fr.events())
+    outcomes = sorted(t.outcome for t in report.timelines)
+    assert outcomes == ["deadline_exceeded", "ok"]
+    expired = next(
+        t for t in report.timelines if t.outcome == "deadline_exceeded"
+    )
+    assert expired.tokens == 0 and not expired.legs
+    ok = next(t for t in report.timelines if t.outcome == "ok")
+    assert ok.tokens == 4  # the completed run-1 record is untouched
+
+
+def test_timeline_recurring_keys_never_merge_across_runs(model_state):
+    """run()'s rids restart at 0 every call (and without a tracer every
+    call shares trace None): a ring spanning several calls must yield
+    one timeline per REQUEST, never fake recovery legs, and each run's
+    delivery readback must stay on its own requests."""
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+    for round_i in range(3):
+        batcher.run([_request(10 * round_i + i, horizon=5)
+                     for i in range(2)])
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 6
+    assert all(not t.recovered for t in report.timelines)
+    assert all(t.outcome == "ok" and t.tokens == 5
+               for t in report.timelines)
+
+
+# -- timeline reconstruction: disaggregated cluster --------------------------
+
+
+def test_timeline_disaggregated_cluster_shows_hops(model_state):
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    cluster = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=1, n_prefill_workers=1),
+        flight_recorder=fr,
+        **BATCHER_KW,
+    )
+    cluster.run([_request(0, horizon=6)])
+    report = _reconciled(build_timelines(fr.events()))
+    (timeline,) = report.timelines
+    assert str(timeline.key).startswith("g")  # router-assigned gid
+    assert timeline.tokens == 6
+    assert timeline.ttft_s is not None
+    hop_types = {hop["type"] for hop in timeline.hops}
+    # the prefill->decode handoff is ON the request's critical path
+    assert {"prefill", "transfer"} <= hop_types
+    assert "prefill" in timeline.phases
+    assert "transfer" in timeline.phases
+
+
+def test_timeline_cluster_run_pending_carries_queue_wait(model_state):
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    # TWO shards: run_pending's rebalance drains + restocks every
+    # queue, and the original enqueue stamps must SURVIVE the re-pack
+    # (restock(enqueued_at=...)) — queue wait measures from submit
+    cluster = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=2),
+        flight_recorder=fr,
+        **BATCHER_KW,
+    )
+    for i in range(4):
+        assert cluster.submit(_request(i, horizon=5)).accepted
+    time.sleep(0.005)
+    results = cluster.run_pending()
+    assert len(results) == 4
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 4
+    # the 5 ms pre-drain sleep must be visible in every queue wait —
+    # a rebalance that re-stamped would read back ~microseconds
+    assert all(t.queue_wait_s >= 0.005 for t in report.timelines)
+    assert all(t.tokens == 5 for t in report.timelines)
+
+
+# -- timeline reconstruction: failover recovery leg --------------------------
+
+
+def test_timeline_failover_recovery_leg(model_state):
+    from beholder_tpu.cluster import ClusterConfig, FailoverConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.reliability.chaos import (
+        WorkerFault,
+        inject_worker_fault,
+    )
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=8192)
+    cluster = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=2, failover=FailoverConfig()),
+        flight_recorder=fr,
+        **BATCHER_KW,
+    )
+    reqs = [_request(i, horizon=5) for i in range(6)]
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=1)
+    )
+    results = cluster.run(reqs)
+    assert cluster.failover.recovered_total > 0
+    assert all(isinstance(r, np.ndarray) for r in results)
+
+    report = _reconciled(build_timelines(fr.events()))
+    assert len(report.timelines) == 6
+    recovered = [t for t in report.timelines if t.recovered]
+    assert recovered, "no timeline shows a recovery leg"
+    for timeline in recovered:
+        assert len(timeline.legs) == 2
+        assert any(h["type"] == "recovery" for h in timeline.hops)
+        assert timeline.recovery_s >= 0.0
+        # TTFT spans the failure: first claim -> the SUCCESSFUL leg's
+        # first token (recovery latency on the critical path)
+        assert timeline.ttft_s is not None
+        assert timeline.ttft_s >= timeline.recovery_s
+        assert timeline.outcome == "ok"
+        assert timeline.tokens == 5
+    # every timeline completed despite the mid-stream death
+    assert all(t.outcome == "ok" for t in report.timelines)
+
+
+def test_dropped_requests_are_visible_to_slo_layer(model_state):
+    """A request the failover layer LOSES (recovery_limit) must count
+    as a bad outcome on the tracker and close its timeline as
+    'dropped' — a recovery storm that drops requests while attainment
+    reads 1.0 would be the exact blind spot the burn page exists for."""
+    from beholder_tpu.cluster import ClusterConfig, FailoverConfig
+    from beholder_tpu.cluster.failover import Dropped
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.reliability.chaos import (
+        WorkerFault,
+        inject_worker_fault,
+    )
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=8192)
+    tracker = SLOTracker(SLOConfig(ttft_ms=60_000.0, tpot_ms=60_000.0))
+    fr.add_listener(tracker.on_event)
+    cluster = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(
+            n_decode_workers=2,
+            failover=FailoverConfig(max_recoveries_per_request=0),
+        ),
+        flight_recorder=fr,
+        **BATCHER_KW,
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=1)
+    )
+    results = cluster.run([_request(i, horizon=5) for i in range(6)])
+    dropped = [r for r in results if isinstance(r, Dropped)]
+    assert dropped, "the zero-recovery cap produced no Dropped outcome"
+    assert tracker.bad >= len(dropped)  # every loss classified bad
+    assert tracker.attainment() < 1.0
+    report = build_timelines(fr.events())
+    by_outcome = {}
+    for t in report.timelines:
+        by_outcome.setdefault(t.outcome, []).append(t)
+    assert len(by_outcome.get("dropped", [])) == len(dropped)
+    for timeline in by_outcome["dropped"]:
+        assert {"type": "dropped", "reason": "recovery_limit"} in [
+            {k: h.get(k) for k in ("type", "reason")}
+            for h in timeline.hops
+        ]
+
+
+# -- the streaming tracker matches the offline fold --------------------------
+
+
+def test_streaming_tracker_matches_offline_timelines(model_state):
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    tracker = SLOTracker(SLOConfig(ttft_ms=60_000.0, tpot_ms=60_000.0))
+    fr.add_listener(tracker.on_event)
+    batcher = _mk_batcher(model, state, flight_recorder=fr)
+    batcher.run([_request(i, horizon=6) for i in range(4)])
+
+    report = build_timelines(fr.events())
+    complete = [t for t in report.timelines if t.ttft_s is not None]
+    assert tracker.good + tracker.bad == len(complete) == 4
+    assert tracker.attainment() == 1.0  # generous objectives
+    digest = tracker._digest("cluster")["ttft"]
+    assert digest.count == 4
+    summary = tracker.artifact_summary()
+    assert summary["ttft_p50_ms"] > 0
+    assert summary["attainment"] == 1.0
+
+
+def test_streaming_first_token_honors_slot_tagged_admits():
+    """The disagg lane's per-request admit rounds carry slot tags: a
+    slot-0 admit must not stamp first-token on the slot-1 request
+    claimed in the same batch (its own prefill/transfer/admit is the
+    bulk of its TTFT); untagged batched admits stamp every claimant."""
+    tracker = SLOTracker(SLOConfig(ttft_ms=60_000.0))
+    for slot in (0, 1):
+        tracker.on_event({
+            "name": "req.claim", "ts_us": 1_000_000, "trace_id": "t",
+            "args": {"rid": slot, "slot": slot, "gid": f"g-{slot}"},
+        })
+    tracker.on_event({
+        "name": "admit", "ph": "X", "ts_us": 1_100_000,
+        "dur_us": 50_000, "trace_id": "t", "args": {"slot": 0},
+    })
+    assert tracker._open["g-0"]["first_us"] == 1_150_000
+    assert tracker._open["g-1"]["first_us"] is None
+    tracker.on_event({
+        "name": "admit", "ph": "X", "ts_us": 1_400_000,
+        "dur_us": 50_000, "trace_id": "t", "args": {"slot": 1},
+    })
+    assert tracker._open["g-1"]["first_us"] == 1_450_000
+    # the streaming TTFTs now match what the offline fold derives
+    for slot, first in ((0, 1_150_000), (1, 1_450_000)):
+        tracker.on_event({
+            "name": "req.retire", "ts_us": 2_000_000, "trace_id": "t",
+            "args": {"rid": slot, "gid": f"g-{slot}", "tokens": 4,
+                     "outcome": "ok"},
+        })
+    digest = tracker._digest("cluster")["ttft"]
+    assert digest.count == 2
+    assert digest.max == pytest.approx(0.45)  # slot 1: its OWN admit
+
+
+# -- default OFF: byte-identical serving + exposition ------------------------
+
+
+def test_slo_off_serving_and_exposition_byte_identical(model_state):
+    """The tentpole's parity pin: with instance.slo absent nothing is
+    built, the default exposition carries no beholder_slo_* series,
+    and arming recorder+tracker only OBSERVES — results stay
+    bitwise-identical."""
+    assert slo_from_config(ConfigNode({})) is None
+    assert slo_from_config(
+        ConfigNode({"instance": {"slo": {"enabled": False}}})
+    ) is None
+    assert "beholder_slo" not in Metrics().registry.render()
+
+    model, state = model_state
+    plain_metrics = Metrics()
+    plain = _mk_batcher(model, state, metrics=plain_metrics)
+    base = plain.run([_request(i, horizon=5) for i in range(3)])
+
+    observed_metrics = Metrics()
+    fr = FlightRecorder(ring_size=512)
+    tracker = SLOTracker(SLOConfig(), registry=observed_metrics.registry)
+    fr.add_listener(tracker.on_event)
+    observed = _mk_batcher(
+        model, state, metrics=observed_metrics, flight_recorder=fr
+    )
+    got = observed.run([_request(i, horizon=5) for i in range(3)])
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the tracker saw every request; the extra series are slo-only
+    assert tracker.good + tracker.bad == 3
+    names = lambda m: {x.name for x in m.registry._metrics}  # noqa: E731
+    extra = names(observed_metrics) - names(plain_metrics)
+    assert extra and all(n.startswith("beholder_slo") for n in extra)
+
+
+def test_slo_from_config_knobs():
+    tracker = slo_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "slo": {
+                        "enabled": True,
+                        "objectives": {
+                            "ttft_ms": 250, "tpot_ms": 40, "target": 0.95,
+                        },
+                        "windows": {"fast_s": 60, "slow_s": 1200},
+                        "burn": {"fast_threshold": 10},
+                    }
+                }
+            }
+        )
+    )
+    assert tracker is not None
+    cfg = tracker.config
+    assert cfg.ttft_ms == 250.0 and cfg.tpot_ms == 40.0
+    assert cfg.target == 0.95
+    assert cfg.fast_window_s == 60.0 and cfg.slow_window_s == 1200.0
+    assert cfg.fast_burn_threshold == 10.0
+    with pytest.raises(ValueError, match="target"):
+        SLOConfig(target=1.5)
+    with pytest.raises(ValueError, match="objectives"):
+        SLOConfig(ttft_ms=0.0)
+
+
+# -- /slo endpoint + degraded healthz ----------------------------------------
+
+
+def test_slo_route_and_degraded_healthz():
+    """The acceptance leg: a synthetically violated objective shows
+    burn > 1 on /slo and degrades /healthz to 503 via the slo check."""
+    from beholder_tpu.health import HealthServer, add_slo_check
+
+    clock = [100.0]
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=1e-3, target=0.99, fast_burn_threshold=2.0),
+        clock=lambda: clock[0],
+    )
+    server = HealthServer(port=0)
+    add_slo_check(server, lambda: tracker)
+    port = server.start()
+    try:
+        # healthy first: nothing observed, burn 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz"
+        ) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["checks"]["slo"]["ok"] is True
+
+        for i in range(5):
+            tracker.observe(ttft_s=1.0, key=i)  # every request violates
+        snapshot = tracker.snapshot()
+        assert snapshot["burn_rate"]["fast"] > 1.0
+        assert snapshot["healthy"] is False
+        assert snapshot["attainment"] == 0.0
+
+        code, ctype, payload = tracker.route()()
+        assert code == 200 and ctype == "application/json"
+        assert json.loads(payload) == snapshot
+
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            body = json.loads(err.read())
+        assert body["checks"]["slo"]["ok"] is False
+        assert "burn rate" in body["checks"]["slo"]["detail"]
+    finally:
+        server.close()
+
+
+def test_health_from_config_registers_slo_check():
+    from beholder_tpu.health import health_from_config
+
+    class _Svc:
+        broker = type("B", (), {"connected": True})()
+        db = None
+        breaker = None
+        cluster = None
+        slo = SLOTracker(SLOConfig())
+
+    svc = _Svc()
+    config = ConfigNode({"instance": {"health": {"enabled": True}}})
+    server = health_from_config(config, svc)
+    try:
+        healthy, checks = server.snapshot()
+        assert "slo" in checks
+        assert checks["slo"]["ok"] is True
+    finally:
+        server.close()
+
+
+# -- satellite: live ring inspection (/debug/flight) -------------------------
+
+
+def test_debug_flight_route_serves_live_ring():
+    fr = FlightRecorder(ring_size=64)
+    fr.instant("req.claim", rid=0, slot=1)
+    fr.record("tick", 1000.0, 0.01, ticks=3)
+    code, ctype, body = fr.route()()
+    assert code == 200
+    assert ctype == "application/x-ndjson"
+    lines = [json.loads(x) for x in body.decode().splitlines()]
+    assert [e["name"] for e in lines] == ["req.claim", "tick"]
+    # and it rides the metrics server without touching the exposition
+    metrics = Metrics()
+    metrics.add_route("/debug/flight", fr.route())
+    port = metrics.expose(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/flight"
+        ) as resp:
+            assert resp.status == 200
+            assert len(resp.read().splitlines()) == 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            exposition = resp.read().decode()
+        assert exposition == metrics.registry.render()
+    finally:
+        metrics.close()
+
+
+def test_add_route_after_expose_takes_effect_immediately():
+    metrics = Metrics()
+    port = metrics.expose(0)
+    try:
+        metrics.add_route(
+            "/slo", lambda: (200, "application/json", b'{"ok": true}')
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo"
+        ) as resp:
+            assert json.loads(resp.read()) == {"ok": True}
+    finally:
+        metrics.close()
+
+
+# -- satellite: intake wait-time histogram -----------------------------------
+
+
+def test_intake_wait_histogram_stamped_at_claim():
+    from beholder_tpu.reliability.shed import IntakeQueue
+
+    clock = [50.0]
+    registry = Registry()
+    queue = IntakeQueue(
+        8, metrics=registry, name="test.q", clock=lambda: clock[0]
+    )
+    # on-demand registration: no series until a drain actually happens
+    assert registry.find("beholder_intake_wait_seconds") is None
+    assert queue.offer("a").accepted
+    clock[0] += 0.25
+    assert queue.offer("b").accepted
+    clock[0] += 0.75
+    assert queue.take_all() == ["a", "b"]
+    assert queue.last_drain_waits == pytest.approx([1.0, 0.75])
+    hist = registry.find("beholder_intake_wait_seconds")
+    assert hist is not None
+    assert hist.count(queue="test.q") == 2
+    assert hist.sum(queue="test.q") == pytest.approx(1.75)
+    # restock WITH the drained stamps preserves the real wait (the
+    # cluster rebalance/drain path); the re-pack drain itself stays
+    # OFF the histogram (record_waits=False) so one queued request
+    # lands exactly ONE wait observation; without stamps restock
+    # re-stamps at restock time (the conservative fallback)
+    queue.offer("c")
+    clock[0] += 1.0
+    items, _, stamps = queue.drain_all(record_waits=False)
+    assert hist.count(queue="test.q") == 2  # the re-pack observed nothing
+    queue.restock(items, enqueued_at=stamps)
+    clock[0] += 0.5
+    queue.take_all()
+    assert queue.last_drain_waits == pytest.approx([1.5])
+    assert hist.count(queue="test.q") == 3  # ONE observation, full wait
+    queue.restock(["c"])
+    clock[0] += 0.5
+    queue.take_all()
+    assert queue.last_drain_waits == pytest.approx([0.5])
+    with pytest.raises(ValueError, match="stamps"):
+        queue.restock(["x", "y"], enqueued_at=[1.0])
+
+
+def test_intake_wait_without_metrics_still_tracks_drain_waits():
+    from beholder_tpu.reliability.shed import IntakeQueue
+
+    clock = [0.0]
+    queue = IntakeQueue(4, clock=lambda: clock[0])
+    queue.offer("x")
+    clock[0] += 2.0
+    queue.take_all()
+    assert queue.last_drain_waits == pytest.approx([2.0])
+
+
+# -- satellite: observation-log rotation -------------------------------------
+
+
+def test_observation_log_rotates_by_size(tmp_path):
+    from beholder_tpu import metrics as metrics_mod
+
+    path = str(tmp_path / "obs.jsonl")
+    metrics_mod.configure_observation_log(path, max_bytes=400, keep=2)
+    try:
+        hist = Registry().histogram("rot_test_seconds", "rotation probe")
+        for _ in range(40):
+            hist.observe(0.01)
+        import os
+
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # keep=2 bounds the set
+        for candidate in (path, path + ".1", path + ".2"):
+            if os.path.exists(candidate):
+                assert os.path.getsize(candidate) < 400 + 200
+                with open(candidate) as f:
+                    for line in f:
+                        json.loads(line)  # every line intact post-rotate
+        # the shutdown flush composes with rotation (flush-safe)
+        metrics_mod.flush_observation_log()
+        hist.observe(0.01)  # transparently re-opens
+        assert os.path.exists(path)
+    finally:
+        metrics_mod.configure_observation_log(None)
+
+
+def test_rotation_policy_survives_malformed_env(monkeypatch):
+    """A bad $METRICS_OBS_ROTATE_BYTES must degrade to the DEFAULT
+    (rotation stays armed) — silently unbounded growth is the bug the
+    feature exists to fix."""
+    from beholder_tpu import metrics as metrics_mod
+
+    monkeypatch.setenv("METRICS_OBS_ROTATE_BYTES", "64M")
+    monkeypatch.setenv("METRICS_OBS_ROTATE_KEEP", "lots")
+    metrics_mod.configure_observation_log(None)  # reset the memo
+    try:
+        max_bytes, keep = metrics_mod._obs_rotation_policy()
+        assert max_bytes == metrics_mod.DEFAULT_OBS_ROTATE_BYTES
+        assert keep == metrics_mod.DEFAULT_OBS_ROTATE_KEEP
+        # explicit config still wins over the (broken) env
+        metrics_mod.configure_observation_log(None, max_bytes=123, keep=1)
+        assert metrics_mod._obs_rotation_policy() == (123, 1)
+    finally:
+        metrics_mod.configure_observation_log(None)
+
+
+def test_observation_log_rotation_disabled_with_zero(tmp_path):
+    from beholder_tpu import metrics as metrics_mod
+
+    path = str(tmp_path / "obs_norot.jsonl")
+    metrics_mod.configure_observation_log(path, max_bytes=0, keep=2)
+    try:
+        hist = Registry().histogram("norot_test_seconds", "probe")
+        for _ in range(50):
+            hist.observe(0.01)
+        import os
+
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".1")
+    finally:
+        metrics_mod.configure_observation_log(None)
+
+
+# -- artifact schema v8 ------------------------------------------------------
+
+
+def test_artifact_v8_round_trip(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_slo_test")
+    rec.record_raw("slo.probe", "trial_wall", [0.1])
+    tracker = SLOTracker(SLOConfig())
+    tracker.observe(ttft_s=0.02, tpot_s=0.001, key="r0")
+    tracker.observe(ttft_s=0.04, tpot_s=0.002, key="r1")
+    rec.record_slo(tracker.artifact_summary())
+    path = rec.write(str(tmp_path / "bench_slo_test.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema_version"] == artifact.SCHEMA_VERSION >= 8
+    slo = obj["slo"]
+    assert slo["ttft_p50_ms"] > 0
+    assert slo["ttft_p95_ms"] >= slo["ttft_p50_ms"]
+    assert slo["tpot_p50_ms"] > 0
+    assert slo["attainment"] == 1.0
+    assert slo["worst_request"]["key"] == "r1"
+
+    # v8 requires the block; v7 artifacts stay exempt
+    bad = dict(obj)
+    del bad["slo"]
+    with pytest.raises(ValueError, match="slo must be a dict"):
+        artifact.validate(bad)
+    v7 = dict(bad, schema_version=7)
+    artifact.validate(v7)
+    with pytest.raises(ValueError, match="slo.ttft_p50_ms"):
+        artifact.validate(dict(obj, slo={**slo, "ttft_p50_ms": "fast"}))
+    with pytest.raises(ValueError, match="worst_request"):
+        artifact.validate(dict(obj, slo={**slo, "worst_request": 3}))
+    # a malformed summary is rejected at record time, not write time
+    with pytest.raises(ValueError, match="slo summary missing"):
+        rec.record_slo({"ttft_p50_ms": 1.0})
+
+
+def test_record_slo_module_plumbing():
+    artifact.set_current(None)
+    artifact.record_slo({})  # no-op without a recorder, never raises
+    rec = artifact.ArtifactRecorder("bench_slo_plumb")
+    artifact.set_current(rec)
+    try:
+        tracker = SLOTracker(SLOConfig())
+        tracker.observe(ttft_s=0.01, key=0)
+        artifact.record_slo(tracker.artifact_summary())
+        assert rec.to_dict()["slo"]["ttft_p50_ms"] > 0
+    finally:
+        artifact.set_current(None)
+
+
+# -- perf gate: the v8 bands -------------------------------------------------
+
+
+def _gate_artifact(ttft_p50=10.0, ttft_p95=20.0, attainment=1.0):
+    rec = artifact.ArtifactRecorder("bench_gate")
+    rec.record_raw("x", "trial_wall", [0.1])
+    rec.record_slo(
+        {
+            "ttft_p50_ms": ttft_p50,
+            "ttft_p95_ms": ttft_p95,
+            "tpot_p50_ms": 1.0,
+            "attainment": attainment,
+            "worst_request": {},
+        }
+    )
+    return rec.to_dict()
+
+
+def test_perf_gate_bands_ttft_tail_and_attainment():
+    from beholder_tpu.tools import perf_gate
+
+    base = _gate_artifact()
+    # identical -> pass, both metrics gated
+    verdict = perf_gate.run_gate(base, _gate_artifact())
+    assert verdict["verdict"] == "pass"
+    gated = {c["metric"] for c in verdict["checks"]}
+    assert {"ttft_tail_ratio", "slo_attainment"} <= gated
+    # tail detaching from the median -> fail (ratio 2.0 -> 4.0)
+    verdict = perf_gate.run_gate(base, _gate_artifact(ttft_p95=40.0))
+    assert "ttft_tail_ratio" in verdict["failed"]
+    # attainment collapse -> fail
+    verdict = perf_gate.run_gate(base, _gate_artifact(attainment=0.5))
+    assert "slo_attainment" in verdict["failed"]
+    # the WORST collapse (0% attainment with live digests) must hit
+    # the gate, not read as "scenario not run"
+    verdict = perf_gate.run_gate(base, _gate_artifact(attainment=0.0))
+    assert "slo_attainment" in verdict["failed"]
+    # absolute ms are reported, never gated
+    reported = verdict["reported_not_gated"]
+    assert reported["slo_ttft_p50_ms"]["current"] == 10.0
+    assert not any(
+        c["metric"].startswith("slo_ttft_p50") for c in verdict["checks"]
+    )
+
+
+def test_perf_gate_skips_missing_slo_block():
+    from beholder_tpu.tools import perf_gate
+
+    rec = artifact.ArtifactRecorder("bench_noslo")
+    rec.record_raw("x", "trial_wall", [0.1])
+    empty = rec.to_dict()  # slo block present but all zeros
+    verdict = perf_gate.run_gate(empty, empty)
+    skipped = {s["metric"] for s in verdict["skipped"]}
+    assert {"ttft_tail_ratio", "slo_attainment"} <= skipped
+    assert verdict["verdict"] == "pass"
