@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel perf-gate lint clean
 
 all: proto native
 
@@ -86,6 +86,17 @@ bench-failover:
 bench-slo:
 	python bench.py --slo-only
 
+# the fused-kernel scenario alone: the fused paged chunk-attention
+# kernel vs the dense-gather verify path, slope-timed INTERLEAVED per
+# shape (bf16 + int8, the small-T causal weak spot called out), an
+# end-to-end fused-vs-dense engine replay with bitwise-asserted equal
+# streams, and the block-size autotuner refresh (writes
+# artifacts/bench_kernel.json AND artifacts/autotune_paged.json; the
+# full `make bench` run carries the same scenario inside
+# bench_e2e.json's v9 kernel block)
+bench-kernel:
+	python bench.py --kernel-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -104,6 +115,8 @@ perf-gate:
 		--baseline artifacts/bench_failover.json --current artifacts/bench_failover.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_slo.json --current artifacts/bench_slo.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_kernel.json --current artifacts/bench_kernel.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

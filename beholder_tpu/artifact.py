@@ -50,7 +50,11 @@ Schema (``validate`` is the authoritative checker)::
                    "deadline_exceeded": 0.0},  # v7: fault tolerance
       "slo": {"ttft_p50_ms": 0.0, "ttft_p95_ms": 0.0,
               "tpot_p50_ms": 0.0, "attainment": 0.0,
-              "worst_request": {}}  # v8: request-level SLO digests
+              "worst_request": {}},  # v8: request-level SLO digests
+      "kernel": {"fused_verify_ratio": 0.0,
+                 "fused_verify_wall_s": 0.0,
+                 "dense_verify_wall_s": 0.0,
+                 "autotuned": {}}  # v9: fused paged-kernel evidence
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -112,6 +116,16 @@ objective attainment, and the worst request seen. The perf gate bands
 the p95/p50 TTFT tail ratio and attainment (environment-normalized;
 absolute milliseconds are reported, never gated — the BENCH_NOTES
 drift doctrine). v1-v7 artifacts remain valid.
+
+Schema v9 (the fused-kernel PR): the run's fused paged-kernel evidence
+rides along (:meth:`ArtifactRecorder.record_kernel`) —
+``fused_verify_ratio`` (fused verify-round wall / dense-gather
+verify-round wall, both slope-timed interleaved on the same host in
+the same session; the perf gate bands it, degradation = the ratio
+RISING), the two walls behind it (reported, never gated), and the
+block-size configs the autotuner picked (``autotuned`` — the same
+entries committed to ``artifacts/autotune_paged.json``). v1-v8
+artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -123,7 +137,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -203,6 +217,15 @@ EMPTY_SLO = {
     "tpot_p50_ms": 0.0,
     "attainment": 0.0,
     "worst_request": {},
+}
+
+#: v9: the kernel block's required shape (an empty block is valid — a
+#: run that never timed the fused kernel still writes a v9 artifact)
+EMPTY_KERNEL = {
+    "fused_verify_ratio": 0.0,
+    "fused_verify_wall_s": 0.0,
+    "dense_verify_wall_s": 0.0,
+    "autotuned": {},
 }
 
 #: default artifact directory: <repo root>/artifacts, independent of cwd
@@ -286,6 +309,7 @@ class ArtifactRecorder:
             key: 0.0 for key in FAILOVER_COUNTERS
         }
         self.slo: dict[str, Any] = copy.deepcopy(EMPTY_SLO)
+        self.kernel: dict[str, Any] = copy.deepcopy(EMPTY_KERNEL)
 
     def section(
         self,
@@ -439,6 +463,19 @@ class ArtifactRecorder:
                 raise ValueError(f"slo summary missing {key!r}")
         self.slo = copy.deepcopy({key: summary[key] for key in EMPTY_SLO})
 
+    def record_kernel(self, summary: dict[str, Any]) -> None:
+        """Adopt one fused-kernel bench summary as the run's v9
+        ``kernel`` block. Last writer wins — the block carries the
+        HEADLINE shape's slope-timed ratio (walls don't sum across
+        shapes); per-shape detail lives in the bench section + raw
+        timings."""
+        for key in EMPTY_KERNEL:
+            if key not in summary:
+                raise ValueError(f"kernel summary missing {key!r}")
+        self.kernel = copy.deepcopy(
+            {key: summary[key] for key in EMPTY_KERNEL}
+        )
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -484,6 +521,7 @@ class ArtifactRecorder:
             "cluster": copy.deepcopy(self.cluster),
             "failover": dict(self.failover),
             "slo": copy.deepcopy(self.slo),
+            "kernel": copy.deepcopy(self.kernel),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -574,6 +612,14 @@ def record_slo(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_slo(summary)
+
+
+def record_kernel(summary: dict) -> None:
+    """Adopt a fused-kernel bench summary into the active recorder's
+    v9 ``kernel`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_kernel(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -719,6 +765,25 @@ def validate(obj: Any) -> None:
                 problems.append(
                     "slo.worst_request must be a dict, "
                     f"got {slo.get('worst_request')!r}"
+                )
+    if isinstance(version, int) and version >= 9:
+        # v9: fused paged-kernel evidence is part of the evidence
+        kernel = obj.get("kernel")
+        if not isinstance(kernel, dict):
+            problems.append("kernel must be a dict (schema v9+)")
+        else:
+            for key in EMPTY_KERNEL:
+                if key == "autotuned":
+                    continue
+                if not isinstance(kernel.get(key), (int, float)):
+                    problems.append(
+                        f"kernel.{key} must be a number, "
+                        f"got {kernel.get(key)!r}"
+                    )
+            if not isinstance(kernel.get("autotuned"), dict):
+                problems.append(
+                    "kernel.autotuned must be a dict, "
+                    f"got {kernel.get('autotuned')!r}"
                 )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

@@ -41,8 +41,10 @@ from beholder_tpu.ops.attention import (
 from beholder_tpu.ops.flash_attention import flash_attention
 from beholder_tpu.ops.moe import SwitchFFN
 from beholder_tpu.ops.paged_attention import (
+    ChunkPagedInfo,
     PagedInfo,
     QuantizedPool,
+    paged_chunk_attention,
     paged_decode_attention,
 )
 
@@ -177,6 +179,30 @@ class Block(nn.Module):
                     v_scale=v_cache.scales if quant else None,
                 )[:, :, None, :]                         # (S, H, 1, Dh)
                 kv_out = (k_cache, v_cache)
+            elif isinstance(index, ChunkPagedInfo):
+                # fused chunk attention (spec verify / prefix-suffix
+                # prefill): the t>=1 chunk attends its slot's pool
+                # pages IN PLACE via the fused Pallas kernel — no
+                # dense gather, no tentative cache writes; the chunk's
+                # own kv rides into the kernel as an overlay and comes
+                # back to the caller, which scatters exactly the
+                # columns it keeps (accepted prefix / suffix pages).
+                # Bitwise-identical to the dense-gather branch below
+                # (pinned by tests/test_paged_chunk_kernel.py).
+                quant = isinstance(k_cache, QuantizedPool)
+                att = paged_chunk_attention(
+                    q, k, v,
+                    k_cache.values if quant else k_cache,
+                    v_cache.values if quant else v_cache,
+                    index.page_table,
+                    index.lens,
+                    ctx_len=index.ctx_len,
+                    live_pages=index.live_pages,
+                    window=self.window,
+                    k_scale=k_cache.scales if quant else None,
+                    v_scale=v_cache.scales if quant else None,
+                )                                        # (S, H, t, Dh)
+                kv_out = (k, v)      # the chunk's OWN hkv-head columns
             else:
                 if getattr(index, "ndim", 0) == 1:
                     # per-sequence positions (continuous batching: each

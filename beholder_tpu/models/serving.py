@@ -401,6 +401,7 @@ def paged_admit_with_prefix(
     suffix_feats: jax.Array,
     suffix_len: jax.Array,
     cached_pages: jax.Array,
+    fused: bool = False,
 ):
     """Admit one request whose first ``len(cached_pages) * page`` tokens
     are already resident in the pool (an automatic-prefix-cache hit —
@@ -413,12 +414,17 @@ def paged_admit_with_prefix(
     forward). ``cached_pages`` is the (P_hit,) static-width chain of
     pool pages holding the prefix KV, root-first.
 
-    The suffix forward needs attention over the cached context, so the
-    hit pages are gathered into a dense per-layer (1, Hkv, T_hit, Dh)
-    context once (dequantized under int8 pools) and the suffix runs
-    through the model's chunked dense-cache path (causal within the
-    chunk, full visibility of the context); the fresh suffix KV is then
-    scattered into newly popped pages exactly like
+    The suffix forward needs attention over the cached context. The
+    default (``fused=False``, the reference oracle) gathers the hit
+    pages into a dense per-layer (1, Hkv, T_hit, Dh) context once
+    (dequantized under int8 pools) and runs the suffix through the
+    model's chunked dense-cache path (causal within the chunk, full
+    visibility of the context); with ``fused=True`` the suffix instead
+    attends the cached pages IN PLACE through the fused chunk kernel
+    (:func:`~beholder_tpu.ops.paged_attention.paged_chunk_attention` —
+    no dense context buffer, int8 dequantized inside the kernel,
+    bitwise-identical admit prediction and pool bytes). Either way the
+    fresh suffix KV is scattered into newly popped pages exactly like
     :func:`paged_admit_batch`'s chunk writes. Cost scales with S, not
     T_hit + S — prefill FLOPs follow NOVEL tokens. The slot takes one
     reference on every adopted page (release drops it; the cache's own
@@ -434,34 +440,56 @@ def paged_admit_with_prefix(
     t_hit = p_hit * page
     p_sfx = s_max // page
 
-    def dense_context(pool):
-        """(1, Hkv, t_hit, Dh) context from the cached pages (bf16)."""
-        if isinstance(pool, QuantizedPool):
-            vals = (
-                pool.values.astype(jnp.float32)
-                * pool.scales[:, :, None, :]
-            ).astype(jnp.bfloat16)
-        else:
-            vals = pool.astype(jnp.bfloat16)
-        g = vals[cached_pages]                    # (P, Hkv, Dh, page)
-        g = g.transpose(1, 0, 3, 2).reshape(
-            vals.shape[1], t_hit, vals.shape[2]
-        )
-        return g[None]
+    if fused:
+        # fused path: the suffix chunk attends the cached pages in
+        # place (per-row offsets all t_hit; ctx width t_hit + s_max —
+        # the dense oracle's buffer width, so the forward is bitwise
+        # the dense path below); kvs come back as the suffix's own
+        # (1, Hkv, s_max, Dh) columns
+        from beholder_tpu.ops.paged_attention import ChunkPagedInfo
 
-    def ctx_cache(pool):
-        ctx = dense_context(pool)
-        buf = jnp.zeros(
-            (1, ctx.shape[1], t_hit + s_max, ctx.shape[3]), jnp.bfloat16
+        info = ChunkPagedInfo(
+            cached_pages[None, :],
+            jnp.full((1,), t_hit, jnp.int32),
+            t_hit + s_max,
         )
-        return jax.lax.dynamic_update_slice(buf, ctx, (0, 0, 0, 0))
+        preds, kvs = model.apply(
+            params, suffix_feats,
+            cache=(state.k_pools, state.v_pools, info),
+        )
+    else:
+        def dense_context(pool):
+            """(1, Hkv, t_hit, Dh) context from the cached pages (bf16)."""
+            if isinstance(pool, QuantizedPool):
+                vals = (
+                    pool.values.astype(jnp.float32)
+                    * pool.scales[:, :, None, :]
+                ).astype(jnp.bfloat16)
+            else:
+                vals = pool.astype(jnp.bfloat16)
+            g = vals[cached_pages]                # (P, Hkv, Dh, page)
+            g = g.transpose(1, 0, 3, 2).reshape(
+                vals.shape[1], t_hit, vals.shape[2]
+            )
+            return g[None]
 
-    ks = tuple(ctx_cache(p) for p in state.k_pools)
-    vs = tuple(ctx_cache(p) for p in state.v_pools)
-    # chunked dense-cache forward: suffix queries attend cached context
-    # + themselves (causal within the chunk — sequence.Block's scalar-
-    # index path); writes land at positions t_hit..t_hit+s_max-1
-    preds, kvs = model.apply(params, suffix_feats, cache=(ks, vs, t_hit))
+        def ctx_cache(pool):
+            ctx = dense_context(pool)
+            buf = jnp.zeros(
+                (1, ctx.shape[1], t_hit + s_max, ctx.shape[3]),
+                jnp.bfloat16,
+            )
+            return jax.lax.dynamic_update_slice(buf, ctx, (0, 0, 0, 0))
+
+        ks = tuple(ctx_cache(p) for p in state.k_pools)
+        vs = tuple(ctx_cache(p) for p in state.v_pools)
+        # chunked dense-cache forward: suffix queries attend cached
+        # context + themselves (causal within the chunk —
+        # sequence.Block's scalar-index path); writes land at
+        # positions t_hit..t_hit+s_max-1
+        preds, kvs = model.apply(
+            params, suffix_feats, cache=(ks, vs, t_hit)
+        )
     last_pred = preds[0, jnp.clip(suffix_len - 1, 0, s_max - 1)]
 
     n_sfx_pages = -(-suffix_len // page)
@@ -473,10 +501,17 @@ def paged_admit_with_prefix(
     k_pools, v_pools = [], []
     for layer, (k_dense, v_dense) in enumerate(kvs):
         def chunks(a):
-            # (1, Hkv, t_hit + s_max, Dh) suffix region
-            #   -> (p_sfx, Hkv, Dh, page)
+            # suffix kv -> (p_sfx, Hkv, Dh, page). The dense path's kv
+            # output is the full (1, Hkv, t_hit + s_max, Dh) updated
+            # buffer (slice the suffix region out); the fused path
+            # already returns only the suffix's own (1, Hkv, s_max,
+            # Dh) columns — same values either way.
             hkv, dh = a.shape[1], a.shape[3]
-            a = jax.lax.dynamic_slice_in_dim(a[0], t_hit, s_max, axis=1)
+            a = (
+                a[0]
+                if fused
+                else jax.lax.dynamic_slice_in_dim(a[0], t_hit, s_max, axis=1)
+            )
             a = a.transpose(0, 2, 1)                 # (Hkv, Dh, s_max)
             a = a.reshape(hkv, dh, p_sfx, page)
             return a.transpose(2, 0, 1, 3)           # (p_sfx, Hkv, Dh, page)
@@ -992,16 +1027,19 @@ def _admit_many_carry(
 
 def _admit_cached_carry(
     model, params, state, carry: _RunCarry, slot, suffix_feats,
-    suffix_len, cached_pages, last_status,
+    suffix_len, cached_pages, last_status, fused=False,
 ):
     """Admit one prefix-cache HIT (:func:`paged_admit_with_prefix`) and
     record its prediction + status one-hot in the device carry — the
     warm-path twin of :func:`_admit_many_carry`. One dispatch per hit:
     hit shapes (pages matched, suffix width) vary per request, so warm
     admits don't batch; the work saved (prefill FLOPs scale with the
-    suffix) dwarfs the extra dispatch."""
+    suffix) dwarfs the extra dispatch. ``fused`` routes the suffix
+    forward through the fused chunk kernel (the batcher's
+    ``fused_verify`` knob)."""
     pred, state = paged_admit_with_prefix(
-        model, params, state, slot, suffix_feats, suffix_len, cached_pages
+        model, params, state, slot, suffix_feats, suffix_len,
+        cached_pages, fused=fused,
     )
     slot = jnp.asarray(slot, jnp.int32)
     return state, carry._replace(
@@ -1348,6 +1386,8 @@ class ContinuousBatcher:
         prefix_cache=None,
         spec=None,
         flight_recorder=None,
+        fused_verify: bool = False,
+        autotune_table: str | None = None,
     ):
         self.model = model
         self.params = params
@@ -1416,6 +1456,35 @@ class ContinuousBatcher:
         #: step engine timeline. None (the default) records nothing and
         #: leaves every path byte-identical.
         self.flight_recorder = flight_recorder
+        #: fused paged verify/prefix attention
+        #: (``instance.serving.fused_verify``): spec verify rounds and
+        #: prefix-hit admissions attend the paged pools IN PLACE
+        #: through :func:`~beholder_tpu.ops.paged_attention.
+        #: paged_chunk_attention` instead of gathering a dense
+        #: per-layer ``(slots, Hkv, max_pages*page, Dh)`` context.
+        #: Served tokens are BITWISE-identical either way (the kernel
+        #: reproduces the dense oracle's arithmetic; pinned by
+        #: tests/test_paged_chunk_kernel.py); what changes is the
+        #: transient (gone), int8 HBM traffic (pages dequantize inside
+        #: the kernel), and the verify page budget (_need_pages stops
+        #: reserving the max_draft tentative-write transient, so more
+        #: requests fit a pool). Off (False, the default) every path
+        #: is byte-identical to the dense-gather batcher.
+        self.fused_verify = bool(fused_verify)
+        if autotune_table is not None:
+            # point the kernel's block-size table at the configured
+            # location (``instance.serving.autotune.table``) before the
+            # first fused build resolves a config. Deliberately
+            # PROCESS-GLOBAL (autotune.configure — last writer wins;
+            # None leaves the current resolution untouched): the table
+            # is a property of the HOST the kernels were tuned on, not
+            # of one batcher, and jit caches keyed per-instance could
+            # not undo a build made under a different table anyway. A
+            # process serving two batchers tuned against different
+            # tables is a config error, not a supported mode.
+            from beholder_tpu.ops import autotune
+
+            autotune.configure(autotune_table)
         #: lazily built by the spec scheduler (a drafter may hold its
         #: own paged state across calls; the controller's EMA carries)
         self._spec_drafter = None
@@ -1460,13 +1529,22 @@ class ContinuousBatcher:
     def _need_pages(self, req: Request) -> int:
         """Worst-case pages a request consumes: prefix + the horizon-1
         fed-back tokens (the horizon-th prediction needs no tick — see
-        run()'s early release). With spec configured, a verify step may
-        tentatively write up to ``max_draft`` tokens past the final
-        accepted end before rollback reclaims them, so admission (and
-        the intake's shed cost) must budget that transient too."""
+        run()'s early release). With spec configured on the
+        DENSE-GATHER verify path, a verify step tentatively writes up
+        to ``max_draft`` tokens past the final accepted end before
+        rollback reclaims them, so admission (and the intake's shed
+        cost) must budget that transient too. The FUSED verify path
+        (``fused_verify``) never writes a rejected token — the chunk
+        attends its own kv from the kernel overlay and only the
+        accepted prefix commits — so its worst case follows accepted
+        tokens (bounded by the horizon: drafts are clamped to the
+        remaining horizon) and the transient budget disappears. That
+        is the capacity gain: the same pool admits more concurrent
+        requests before shedding (pinned by
+        tests/test_paged_chunk_kernel.py)."""
         feats_len = len(req.progress) - 1
         tokens = feats_len + max(req.horizon - 1, 0)
-        if self.spec is not None:
+        if self.spec is not None and not self.fused_verify:
             tokens += self.spec.max_draft
         return -(-tokens // self.page_size)
 
@@ -2112,10 +2190,14 @@ class ContinuousBatcher:
                         s_len = t - t_hit
                         s_pad = -(-s_len // self.page_size) * self.page_size
                         admit_c = self._cached_jit(
-                            ("admit_cached", len(hit_pages), s_pad),
+                            (
+                                "admit_cached", len(hit_pages), s_pad,
+                                self.fused_verify,
+                            ),
                             lambda: lambda p, s, c, sl, f, ln, pg, st: (
                                 _admit_cached_carry(
-                                    self.model, p, s, c, sl, f, ln, pg, st
+                                    self.model, p, s, c, sl, f, ln, pg,
+                                    st, fused=self.fused_verify,
                                 )
                             ),
                         )

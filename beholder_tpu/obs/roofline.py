@@ -42,12 +42,19 @@ from __future__ import annotations
 import time
 from typing import Any
 
-#: the dispatch-phase -> kernel-family map the serving layer uses
+#: the dispatch-phase -> kernel-family DOCUMENTATION map (the serving
+#: layer passes the family string to kernel_tags() at the call site;
+#: nothing looks families up here). ``verify`` rounds carry family
+#: "verify" on the dense-gather path and "paged_chunk" with the fused
+#: kernel armed (ContinuousBatcher(fused_verify=True) —
+#: ops.paged_attention.paged_chunk_attention), so the perf gate's
+#: per-family ``kernel_ceiling_frac`` check sees the fused kernel's
+#: achieved ceiling fraction as its own series.
 PHASE_FAMILIES = {
     "admit": "flash",    # prefill: dense/flash-path forwards
     "wave": "paged",     # fused admit+scan: decode-dominated
     "tick": "paged",     # paged decode ticks
-    "verify": "verify",  # spec chunked verify forwards
+    "verify": "verify",  # spec chunked verify ("paged_chunk" fused)
 }
 
 

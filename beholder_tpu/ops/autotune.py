@@ -1,0 +1,287 @@
+"""Micro-profiling-guided block-size autotuner for the paged kernels.
+
+"Optimizing CUDA like a Human" (PAPERS.md) argues kernel block sizes
+should come from measurement on the target machine, not from folklore —
+and BENCH_NOTES.md's drift doctrine says the only trustworthy clock on
+this shared host is the slope harness (k chained calls + ONE readback;
+the readback constant cancels). This module applies both to the fused
+paged chunk-attention kernel (:func:`beholder_tpu.ops.paged_attention.
+paged_chunk_attention`):
+
+- a **search** (:func:`search`) slope-times the kernel at every
+  candidate ``(slots_per_block, pages_per_block)`` config for one
+  shape class and keeps the fastest;
+- the winners persist to a JSON **table** (``artifacts/
+  autotune_paged.json`` by default — committed, so CI and every later
+  session build the same kernels) keyed by :func:`shape_key`;
+- kernel **build time** resolves the config through
+  :func:`resolve_config`: explicit config > table hit > ``DEFAULTS``
+  (a cold miss silently falls back — an untuned shape must run, just
+  not optimally).
+
+The search space is restricted BY CONSTRUCTION to numerics-neutral
+knobs: ``slots_per_block`` (bq — how many slots' query rows one grid
+step processes; per-slot attention is independent, so blocking the
+batch dim cannot change any value) and ``pages_per_block`` (the kv
+block granularity — how many pages each double-buffered DMA round
+moves; DMA grouping never touches the math). A tuned kernel is
+therefore bitwise-identical to the default-config kernel — the
+autotuner moves wall time only (pinned by
+``tests/test_paged_chunk_kernel.py``).
+
+Table schema (``validate_table`` is the checker)::
+
+    {"schema": "beholder-autotune-table", "schema_version": 1,
+     "entries": {"<shape_key>": {"config": {"slots_per_block": 4,
+                                            "pages_per_block": 2},
+                                 "per_call_s": 1.2e-4,
+                                 "candidates": {"<cfg>": s, ...},
+                                 "measured_unix_s": ...}}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable
+
+SCHEMA = "beholder-autotune-table"
+SCHEMA_VERSION = 1
+
+#: the cold-miss fallback: safe everywhere (divisor-clamped at build),
+#: measured-reasonable on the CPU interpreter and small TPU shapes
+DEFAULTS: dict[str, int] = {"slots_per_block": 4, "pages_per_block": 2}
+
+#: env override for the table location (CI / alternate hosts)
+TABLE_ENV = "BEHOLDER_AUTOTUNE_TABLE"
+
+#: default committed location: <repo>/artifacts/autotune_paged.json
+DEFAULT_TABLE_PATH = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "artifacts",
+    "autotune_paged.json",
+)
+
+_lock = threading.Lock()
+_table: dict[str, Any] | None = None
+_table_path: str | None = None
+
+
+def shape_key(
+    family: str,
+    *,
+    slots: int,
+    width: int,
+    max_pages: int,
+    page: int,
+    kv_heads: int,
+    head_dim: int,
+    dtype: str,
+) -> str:
+    """One shape class = one table row. Exact-keyed (no bucketing): a
+    near-miss silently tuned for a DIFFERENT shape is worse than the
+    defaults; the fallback direction is explicit instead."""
+    return (
+        f"{family}/s{slots}w{width}p{max_pages}x{page}"
+        f"h{kv_heads}d{head_dim}/{dtype}"
+    )
+
+
+def configure(path: str | None) -> None:
+    """Point the lazy table load at ``path`` (``instance.serving.
+    autotune.table`` wiring) and drop any cached table so the next
+    lookup re-reads. ``None`` restores the default resolution
+    ($BEHOLDER_AUTOTUNE_TABLE, then the committed artifact)."""
+    global _table, _table_path
+    with _lock:
+        _table_path = path
+        _table = None
+
+
+def table_path() -> str:
+    return (
+        _table_path
+        or os.environ.get(TABLE_ENV)
+        or DEFAULT_TABLE_PATH
+    )
+
+
+def load_table(path: str | None = None) -> dict[str, Any]:
+    """The table's ``entries`` dict; a missing or malformed file is an
+    EMPTY table (cold start must serve, never crash), cached after the
+    first read."""
+    global _table
+    if path is not None:
+        return _read_entries(path)
+    with _lock:
+        if _table is None:
+            _table = _read_entries(table_path())
+        return _table
+
+
+def _read_entries(path: str) -> dict[str, Any]:
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        validate_table(obj)
+        return dict(obj["entries"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
+
+
+def validate_table(obj: Any) -> None:
+    """Raise ``ValueError`` unless ``obj`` is a well-formed table —
+    the CI artifact gate's check on the committed file."""
+    if not isinstance(obj, dict):
+        raise ValueError("autotune table must be a dict")
+    if obj.get("schema") != SCHEMA:
+        raise ValueError(f"schema must be {SCHEMA!r}, got {obj.get('schema')!r}")
+    if not isinstance(obj.get("schema_version"), int):
+        raise ValueError("schema_version must be an int")
+    entries = obj.get("entries")
+    if not isinstance(entries, dict):
+        raise ValueError("entries must be a dict")
+    for key, entry in entries.items():
+        if not isinstance(entry, dict) or not isinstance(
+            entry.get("config"), dict
+        ):
+            raise ValueError(f"entry {key!r} must carry a config dict")
+        for knob, value in entry["config"].items():
+            if not isinstance(value, int) or value < 1:
+                raise ValueError(
+                    f"entry {key!r} config {knob}={value!r} must be a "
+                    "positive int"
+                )
+        if not isinstance(entry.get("per_call_s"), (int, float)):
+            raise ValueError(f"entry {key!r} needs a numeric per_call_s")
+
+
+def save_table(
+    entries: dict[str, Any], path: str | None = None
+) -> str:
+    """Persist ``entries`` (and, when writing the ACTIVE table, refresh
+    the cache so builds in this process see the new winners
+    immediately — a side copy saved to an explicit other path must not
+    hijack what :func:`resolve_config` resolves). Returns the path."""
+    global _table
+    path = path or table_path()
+    obj = {
+        "schema": SCHEMA,
+        "schema_version": SCHEMA_VERSION,
+        "entries": entries,
+    }
+    validate_table(obj)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    if os.path.abspath(path) == os.path.abspath(table_path()):
+        with _lock:
+            _table = dict(entries)
+    return path
+
+
+def resolve_config(
+    key: str, explicit: dict[str, int] | None = None
+) -> dict[str, int]:
+    """The config one kernel build uses: explicit wins, then the
+    persisted table, then :data:`DEFAULTS`. Deterministic — the same
+    table yields the same config yields the same compiled kernel (the
+    jit cache keys on the normalized config tuple)."""
+    if explicit is not None:
+        return {**DEFAULTS, **explicit}
+    entry = load_table().get(key)
+    if entry is not None and isinstance(entry.get("config"), dict):
+        return {**DEFAULTS, **entry["config"]}
+    return dict(DEFAULTS)
+
+
+def normalize(config: dict[str, int], slots: int, max_pages: int) -> tuple[int, int]:
+    """Clamp a config to what the shape admits: ``slots_per_block``
+    becomes the largest divisor of ``slots`` not above it (the grid
+    must tile the batch exactly — padding a slot block would change
+    the einsum shapes the bitwise contract is built on) and never
+    above ``slots // 2`` for multi-slot batches — the kernel's
+    no-dense-transient guarantee is a CONTRACT, not a tuning
+    preference, so no table entry (or explicit config) may buy wall
+    time by growing the per-step working set back into the full
+    ``(slots, Hkv, max_pages*page, Dh)`` gather the kernel exists to
+    kill. ``pages_per_block`` is capped at the table width."""
+    sb = max(1, int(config.get("slots_per_block", DEFAULTS["slots_per_block"])))
+    sb = min(sb, max(1, slots // 2))
+    while slots % sb:
+        sb -= 1
+    pb = max(1, int(config.get("pages_per_block", DEFAULTS["pages_per_block"])))
+    pb = min(pb, max(1, max_pages))
+    return sb, pb
+
+
+def candidate_configs(slots: int, max_pages: int) -> list[dict[str, int]]:
+    """The search grid for one shape: slot-block sizes over the
+    divisors of ``slots`` up to the no-transient cap (``slots // 2``
+    — see :func:`normalize`), page-block sizes over small powers of
+    two capped at the table width."""
+    cap = max(1, slots // 2)
+    sbs = [d for d in (1, 2, 4, 8, 16) if d <= cap and slots % d == 0]
+    pbs = [p for p in (1, 2, 4, 8) if p <= max(1, max_pages)]
+    return [
+        {"slots_per_block": sb, "pages_per_block": pb}
+        for sb in sbs
+        for pb in pbs
+    ]
+
+
+def search(
+    key: str,
+    build_fn: Callable[[dict[str, int]], Callable[[Any], Any]],
+    candidates: list[dict[str, int]],
+    *,
+    k1: int = 4,
+    k2: int = 16,
+    rounds: int = 2,
+) -> tuple[dict[str, int], dict[str, float]]:
+    """Slope-time every candidate and return (winner, per-candidate
+    seconds). ``build_fn(config)`` returns a chainable ``fn(prev) ->
+    out`` for the slope harness (:func:`beholder_tpu.obs.roofline.
+    _slope_seconds` — k chained calls + one scalar readback, min over
+    rounds; the harness the flight recorder's ceilings already trust
+    on this host)."""
+    from beholder_tpu.obs.roofline import _slope_seconds
+
+    timings: dict[str, float] = {}
+    best: dict[str, int] | None = None
+    best_s = float("inf")
+    for config in candidates:
+        fn = build_fn(config)
+        per_call = _slope_seconds(fn, k1, k2, rounds)
+        label = ",".join(f"{k}={v}" for k, v in sorted(config.items()))
+        timings[label] = per_call
+        if per_call < best_s:
+            best_s = per_call
+            best = config
+    assert best is not None, "search needs at least one candidate"
+    return best, timings
+
+
+def autotune_entry(
+    key: str,
+    build_fn: Callable[[dict[str, int]], Callable[[Any], Any]],
+    candidates: list[dict[str, int]],
+    **search_kw: Any,
+) -> dict[str, Any]:
+    """One table entry for ``key``: run :func:`search` and package the
+    winner with its evidence (every candidate's slope time rides along
+    — the table is an artifact, and artifacts carry raw numbers)."""
+    import time
+
+    best, timings = search(key, build_fn, candidates, **search_kw)
+    label = ",".join(f"{k}={v}" for k, v in sorted(best.items()))
+    return {
+        "config": best,
+        "per_call_s": timings[label],
+        "candidates": timings,
+        "measured_unix_s": time.time(),
+    }

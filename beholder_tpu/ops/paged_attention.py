@@ -57,6 +57,14 @@ Kernel design:
   tiny pages).
 - On non-TPU backends the kernel runs in interpreter mode — the CPU-mesh
   tests exercise the same code path.
+
+This module also carries the fused paged CHUNK kernel
+(:func:`paged_chunk_attention` + :class:`ChunkPagedInfo`, further
+down): the t>=1 twin of the decode kernel that serves spec-verify
+rounds and prefix-hit admissions in place of the dense-gather
+transient those paths used to materialize, bitwise-identical to the
+dense oracle by construction and block-size-autotuned via
+:mod:`beholder_tpu.ops.autotune`.
 """
 
 from __future__ import annotations
@@ -429,4 +437,609 @@ def paged_decode_attention(
         q, k_pool, v_pool, page_table.astype(jnp.int32),
         lens.astype(jnp.int32), k_scale, v_scale, window=window,
         interpret=_interpret(),
+    )
+
+
+# -- fused paged CHUNK attention (verify / prefix-suffix prefill) ------------
+
+
+#: tests flip this to route non-TPU paged_chunk_attention calls through
+#: the pallas kernel in interpreter mode instead of the portable
+#: :func:`_chunk_reference` transport. By itself this pins the pallas
+#: body's MATH stages (overlay + attend + masking, the shared
+#: ``_chunk_block_math``) bitwise against the reference twin and the
+#: dense oracle — the interpreted body assembles its context as a
+#: value gather, NOT via the zero+double-buffered-DMA pipeline a real
+#: TPU compiles. Flip :data:`FORCE_PALLAS_INTERPRET_DMA` as well to
+#: drive that DMA staging assembly itself (start/wait rounds, int8
+#: stage+dequant) through the interpreter. Never set in production:
+#: the interpreter materializes a whole-pool copy per grid step.
+FORCE_PALLAS_INTERPRET = False
+
+#: with :data:`FORCE_PALLAS_INTERPRET`, additionally runs the kernel's
+#: REAL assembly stage — the zeroed VMEM scratch, the 1-ahead
+#: double-buffered ``make_async_copy`` rounds, the post-wait int8
+#: dequant — under the interpreter instead of the value-gather
+#: shortcut, so the TPU DMA pipeline is itself pinned bitwise in CI.
+#: Interpreter DMA descriptors cost ~50 us each: tiny pools only.
+FORCE_PALLAS_INTERPRET_DMA = False
+
+
+class ChunkPagedInfo(NamedTuple):
+    """Cache index marking a FUSED chunk-attention forward
+    (:class:`beholder_tpu.models.sequence.Block` dispatches on it, the
+    way :class:`PagedInfo` marks the paged decode tick): the ``t >= 1``
+    chunk attends its slot's pool pages IN PLACE via
+    :func:`paged_chunk_attention` — no dense
+    ``(slots, Hkv, max_pages*page, Dh)`` gather ever materializes —
+    and the block returns the chunk's OWN (k, v) projections instead
+    of a full-width updated cache, so the caller scatters exactly the
+    chunk's columns into the pool (spec verify commits the accepted
+    prefix; prefix-hit admission scatters the suffix).
+
+    - ``page_table``: (S, P) pool page ids; only pages holding
+      positions ``< lens[s]`` are read (the chunk's own positions come
+      from the overlay, never from pages).
+    - ``lens``: (S,) — row ``s``'s chunk occupies positions
+      ``lens[s]..lens[s]+t-1`` and row ``j`` attends positions
+      ``<= lens[s]+j`` (the dense path's per-row causal-offset mask).
+    - ``ctx_len``: static attention width. The fused kernel matches
+      the dense-gather oracle BITWISE, and XLA reassociates masked
+      softmax reductions differently per width, so the width must be
+      the oracle's exactly: ``P*page`` for spec verify (the dense path
+      gathers the whole table row), ``P*page + t`` for prefix-hit
+      admission (cached context plus the appended suffix chunk).
+    - ``live_pages``: OPTIONAL static bound on table columns actually
+      READ (None = all, what the serving scheduler passes — one
+      compiled program per chunk width, no per-occupancy recompiles).
+      The kernel's page traffic is bounded DYNAMICALLY regardless:
+      the TPU transport's DMA rounds are gated on each slot's real
+      ``ceil(lens[s]/page)``, so pages past the committed length are
+      never moved (the paged promise — traffic follows tokens in
+      flight), and dead positions are exact zeros — a masked lane
+      contributes -inf to the max and an exact 0.0 to the softmax sum
+      and PV either way, so neither bound changes values. The static
+      bound additionally trims compiled code size / gather width for
+      callers that know a hard cap (tests and the bench exercise it).
+    """
+
+    page_table: jax.Array
+    lens: jax.Array
+    ctx_len: int
+    live_pages: int | None = None
+
+
+def _chunk_kernel(
+    table_ref, lens_ref, q_ref, kc_ref, vc_ref, kp_ref, vp_ref, ks_ref,
+    vs_ref, o_ref, kctx, vctx, kstage, vstage, ksstage, vsstage, sems, *,
+    page, window, sb, pb, max_pages, live_pages, ctx_len, group,
+    dma,
+):
+    """One grid step = one block of ``sb`` slots (see
+    :func:`paged_chunk_attention`). Three stages:
+
+    1. **Assemble** each slot's bf16 context in VMEM: zero the block's
+       (sb, Hkv, Dh, ctx_len) scratch, then move the slot's COMMITTED
+       pages (``ceil(lens[s]/page)`` of them — freshly-popped or stale
+       table entries past the committed length are never touched) in
+       rounds of ``pb`` pages with a 1-ahead pipeline (round ``r+1``'s
+       DMAs issue before round ``r``'s wait — the memory system
+       overlaps them; ``pb`` is the autotuned DMA granularity). Int8
+       pools stage into int8/f32 scratch and dequantize right after
+       the wait — int8 stays the HBM representation, the bf16
+       inflation exists only page-at-a-time in VMEM. With ``dma``
+       off (the interpreted test transport's default; the
+       FORCE_PALLAS_INTERPRET_DMA tests re-enable it) the context is
+       instead built as a VALUE — per-page ref reads
+       concatenated, which XLA fuses into one gather-shaped copy. The
+       interpreter pays real costs for the TPU-shaped alternatives
+       (a ``make_async_copy`` descriptor is ~50 us of semaphore
+       bookkeeping, and every indexed scratch STORE re-materializes
+       the whole functional buffer — measured 10-30x over the math at
+       the serving shape), while per-page READS are cheap dynamic
+       slices. Identical bytes either way; only the transport differs
+       per backend. Dead positions past a block's live pages hold
+       stale pool bytes here exactly as the dense oracle's gather
+       does — every such lane is masked to -1e30 before softmax and
+       its PV weight is an exact f32 zero, so values there never
+       reach the output.
+    2. **Overlay** the chunk's own (k, v) columns at positions
+       ``lens[s]+j`` — the same scatter the dense oracle performs on
+       its gathered buffer, so chunk self-attention reads the same
+       values.
+    3. **Attend** with the dense path's EXACT op sequence and shapes
+       (bf16 score einsum, f32 ``/sqrt(Dh)``, -1e30 mask, f32 softmax,
+       bf16 PV einsum): per-slot attention is batch-independent and
+       masked lanes contribute exact zeros, so the fused output is
+       BITWISE the dense-gather output — the property the serving
+       knob's byte-identity contract rests on (pinned by
+       ``tests/test_paged_chunk_kernel.py``).
+    """
+    quant = ks_ref is not None
+    i = pl.program_id(0)
+    s0 = i * sb
+    w = q_ref.shape[2]
+    hkv = kp_ref.shape[1]
+
+    length = [lens_ref[s0 + s] for s in range(sb)]
+    # committed pages only: positions >= lens[s] come from the overlay
+    n_hi = [
+        jnp.minimum((length[s] + page - 1) // page, live_pages)
+        for s in range(sb)
+    ]
+    if window is None:
+        p_lo = [jnp.int32(0)] * sb
+    else:
+        # the lowest position any chunk row can see is row 0's
+        # lens[s] - (window - 1); wholly earlier pages are masked out
+        # either way, so their DMAs are pure waste
+        p_lo = [
+            jnp.maximum(length[s] - (window - 1), 0) // page
+            for s in range(sb)
+        ]
+
+    def page_live(s, p):
+        return (p >= p_lo[s]) & (p < n_hi[s])
+
+    n_rounds = -(-live_pages // pb) if live_pages else 0
+
+    def start(r, buf):
+        for s in range(sb):
+            for j in range(pb):
+                p = r * pb + j
+                if p >= live_pages:
+                    continue
+
+                @pl.when(page_live(s, p))
+                def _(s=s, j=j, p=p):
+                    pid = table_ref[s0 + s, p]
+                    dst = pl.ds(p * page, page)
+                    if quant:
+                        pltpu.make_async_copy(
+                            kp_ref.at[pid], kstage.at[buf, s, j],
+                            sems.at[0, buf, s, j],
+                        ).start()
+                        pltpu.make_async_copy(
+                            vp_ref.at[pid], vstage.at[buf, s, j],
+                            sems.at[1, buf, s, j],
+                        ).start()
+                        pltpu.make_async_copy(
+                            ks_ref.at[pid], ksstage.at[buf, s, j],
+                            sems.at[2, buf, s, j],
+                        ).start()
+                        pltpu.make_async_copy(
+                            vs_ref.at[pid], vsstage.at[buf, s, j],
+                            sems.at[3, buf, s, j],
+                        ).start()
+                    else:
+                        pltpu.make_async_copy(
+                            kp_ref.at[pid], kctx.at[s, :, :, dst],
+                            sems.at[0, buf, s, j],
+                        ).start()
+                        pltpu.make_async_copy(
+                            vp_ref.at[pid], vctx.at[s, :, :, dst],
+                            sems.at[1, buf, s, j],
+                        ).start()
+
+    def wait(r, buf):
+        for s in range(sb):
+            for j in range(pb):
+                p = r * pb + j
+                if p >= live_pages:
+                    continue
+
+                @pl.when(page_live(s, p))
+                def _(s=s, j=j, p=p):
+                    pid = table_ref[s0 + s, p]
+                    dst = pl.ds(p * page, page)
+                    if quant:
+                        pltpu.make_async_copy(
+                            kp_ref.at[pid], kstage.at[buf, s, j],
+                            sems.at[0, buf, s, j],
+                        ).wait()
+                        pltpu.make_async_copy(
+                            vp_ref.at[pid], vstage.at[buf, s, j],
+                            sems.at[1, buf, s, j],
+                        ).wait()
+                        pltpu.make_async_copy(
+                            ks_ref.at[pid], ksstage.at[buf, s, j],
+                            sems.at[2, buf, s, j],
+                        ).wait()
+                        pltpu.make_async_copy(
+                            vs_ref.at[pid], vsstage.at[buf, s, j],
+                            sems.at[3, buf, s, j],
+                        ).wait()
+                        # dequant right after the DMA: per-(head, token)
+                        # scales broadcast over Dh, rounded to bf16 —
+                        # the EXACT arithmetic of the dense oracle's
+                        # _gather_dense, so int8 fused == int8 dense
+                        kctx[s, :, :, dst] = (
+                            kstage[buf, s, j].astype(jnp.float32)
+                            * ksstage[buf, s, j][:, None, :]
+                        ).astype(jnp.bfloat16)
+                        vctx[s, :, :, dst] = (
+                            vstage[buf, s, j].astype(jnp.float32)
+                            * vsstage[buf, s, j][:, None, :]
+                        ).astype(jnp.bfloat16)
+                    else:
+                        pltpu.make_async_copy(
+                            kp_ref.at[pid], kctx.at[s, :, :, dst],
+                            sems.at[0, buf, s, j],
+                        ).wait()
+                        pltpu.make_async_copy(
+                            vp_ref.at[pid], vctx.at[s, :, :, dst],
+                            sems.at[1, buf, s, j],
+                        ).wait()
+
+    if not dma:
+        # interpreter assembly (the force-pallas TEST transport; the
+        # production non-TPU route is :func:`_chunk_reference`, which
+        # never enters pallas — see paged_chunk_attention): build the
+        # block's context as a VALUE via one gather off the whole-ref
+        # read. The interpreter materializes that read at POOL size
+        # per grid step, so this path is only for the small pools the
+        # kernel tests use — its job is pinning the pallas body's MATH
+        # stages bitwise against the reference twin, not speed; the
+        # DMA assembly itself is pinned separately through
+        # FORCE_PALLAS_INTERPRET_DMA.
+        dh = kp_ref.shape[2]
+        tail = ctx_len - live_pages * page
+        block_tab = table_ref[pl.ds(s0, sb), :][:, :live_pages]
+
+        def assemble(pool_ref, scale_ref):
+            g = pool_ref[...][block_tab]  # (sb, P', Hkv, Dh, page)
+            if quant:
+                g = (
+                    g.astype(jnp.float32)
+                    * scale_ref[...][block_tab][:, :, :, None, :]
+                ).astype(jnp.bfloat16)
+            g = g.transpose(0, 2, 3, 1, 4).reshape(
+                sb, hkv, dh, live_pages * page
+            )
+            if tail:
+                g = jnp.concatenate(
+                    [g, jnp.zeros((sb, hkv, dh, tail), jnp.bfloat16)],
+                    axis=-1,
+                )
+            return g                             # (sb, Hkv, Dh, L) bf16
+
+        k_lanes = assemble(kp_ref, ks_ref)
+        v_lanes = assemble(vp_ref, vs_ref)
+    else:
+        # stage 1 (TPU): zero + DMA into the persistent VMEM scratch
+        # (dead positions must be real finite zeros — a masked 0-weight
+        # times stale-NaN scratch would poison the PV accumulator)
+        kctx[...] = jnp.zeros(kctx.shape, kctx.dtype)
+        vctx[...] = jnp.zeros(vctx.shape, vctx.dtype)
+        if n_rounds:
+            start(0, 0)
+            for r in range(n_rounds):
+                if r + 1 < n_rounds:
+                    start(r + 1, (r + 1) % 2)
+                wait(r, r % 2)
+        k_lanes = kctx[...]                      # (sb, Hkv, Dh, L) bf16
+        v_lanes = vctx[...]
+
+    o_ref[...] = _chunk_block_math(
+        q_ref[...], kc_ref[...], vc_ref[...], k_lanes, v_lanes,
+        jnp.stack(length), window=window, ctx_len=ctx_len, group=group,
+    )
+
+
+def _chunk_block_math(
+    q, kc, vc, k_lanes, v_lanes, lens_vec, *, window, ctx_len, group
+):
+    """Stages 2+3 of the fused chunk attention, shared VERBATIM by the
+    pallas kernel body and the reference twin (one op sequence = the
+    bitwise contract cannot drift between transports):
+
+    2. **Overlay** the chunk's own (k, v) columns at positions
+       ``lens[s]+j`` — the same scatter the dense oracle performs on
+       its gathered buffer, so chunk self-attention reads the same
+       values.
+    3. **Attend** with the dense cache path's EXACT op sequence and
+       shapes (bf16 score einsum, f32 ``/sqrt(Dh)``, -1e30 mask, f32
+       softmax, bf16 PV einsum — models.sequence.Block's vector-index
+       t>1 branch, op for op): per-slot attention is batch-independent
+       and masked lanes contribute exact zeros, so the fused output is
+       BITWISE the dense-gather output — the property the serving
+       knob's byte-identity contract rests on (pinned by
+       ``tests/test_paged_chunk_kernel.py``)."""
+    sb, h, w, dh = q.shape
+    hkv = k_lanes.shape[1]
+    kall = k_lanes.transpose(0, 1, 3, 2)         # (sb, Hkv, L, Dh) bf16
+    vall = v_lanes.transpose(0, 1, 3, 2)
+    rows = jnp.arange(sb)
+    pos_w = lens_vec[:, None] + jnp.arange(w)                   # (sb, W)
+    kall = kall.at[rows[:, None], :, pos_w, :].set(
+        kc.transpose(0, 2, 1, 3).astype(kall.dtype), mode="drop"
+    )
+    vall = vall.at[rows[:, None], :, pos_w, :].set(
+        vc.transpose(0, 2, 1, 3).astype(vall.dtype), mode="drop"
+    )
+    qg = q.astype(kall.dtype).reshape(sb, hkv, group, w, dh)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kall) / jnp.sqrt(
+        jnp.float32(dh)
+    )
+    positions = jnp.arange(ctx_len)
+    live = positions[None, None, :] <= pos_w[:, :, None]      # (sb, W, L)
+    if window is not None:
+        live = live & (positions[None, None, :] > pos_w[:, :, None] - window)
+    scores = jnp.where(live[:, None, None, :, :], scores, _NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum(
+        "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), vall
+    ).reshape(sb, h, w, dh)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ctx_len", "live_pages", "window", "sb"),
+)
+def _chunk_reference(
+    q, k_chunk, v_chunk, k_pool, v_pool, page_table, lens, k_scale,
+    v_scale, *, ctx_len, live_pages, window, sb,
+):
+    """The kernel's PORTABLE transport (every non-TPU backend): the
+    same block-streamed algorithm — assemble one slot block's context,
+    overlay, attend, next block — expressed as plain XLA ops. The
+    pallas interpreter taxes TPU-shaped constructs with real copies (a
+    whole-pool materialization per whole-ref read, a full functional
+    buffer per indexed scratch store, ~50 us per DMA descriptor —
+    all measured), so on CPU the honest instantiation of the SAME
+    per-block working-set contract is a value-level gather per block:
+    XLA's gather reads only the indexed pages, whatever the pool size.
+    Stages 2+3 are :func:`_chunk_block_math`, the identical code the
+    pallas body runs — the two transports cannot drift."""
+    slots, h, w, dh = q.shape
+    hkv = k_pool.shape[1]
+    page = k_pool.shape[3]
+    quant = k_scale is not None
+    tail = ctx_len - live_pages * page
+
+    def assemble(pool, scales, block_tab):
+        g = pool[block_tab]           # (sb, P', Hkv, Dh, page) gather
+        if quant:
+            # dequant AFTER the gather: only gathered pages pay the
+            # bf16 inflation (the dense oracle inflates the WHOLE pool
+            # first); per-element arithmetic is identical, so values
+            # still match the oracle bitwise
+            g = (
+                g.astype(jnp.float32)
+                * scales[block_tab][:, :, :, None, :]
+            ).astype(jnp.bfloat16)
+        else:
+            g = g.astype(jnp.bfloat16)
+        g = g.transpose(0, 2, 3, 1, 4).reshape(
+            sb, hkv, dh, live_pages * page
+        )
+        if tail:
+            g = jnp.concatenate(
+                [g, jnp.zeros((sb, hkv, dh, tail), jnp.bfloat16)],
+                axis=-1,
+            )
+        return g                                 # (sb, Hkv, Dh, L) bf16
+
+    outs = []
+    for i in range(slots // sb):
+        rows = slice(i * sb, (i + 1) * sb)
+        block_tab = page_table[rows, :live_pages]
+        outs.append(
+            _chunk_block_math(
+                q[rows], k_chunk[rows], v_chunk[rows],
+                assemble(k_pool, k_scale, block_tab),
+                assemble(v_pool, v_scale, block_tab),
+                lens[rows], window=window, ctx_len=ctx_len,
+                group=h // hkv,
+            )
+        )
+    return jnp.concatenate(outs, axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "ctx_len", "live_pages", "window", "sb", "pb", "interpret",
+        "dma",
+    ),
+)
+def _chunk_call(
+    q, k_chunk, v_chunk, k_pool, v_pool, page_table, lens, k_scale,
+    v_scale, *, ctx_len, live_pages, window, sb, pb, interpret, dma,
+):
+    slots, h, w, dh = q.shape
+    _, hkv, _, page = k_pool.shape
+    max_pages = page_table.shape[1]
+    quant = k_scale is not None
+
+    smem = pl.BlockSpec(memory_space=_MEMORY_SPACE.SMEM)
+    hbm = pl.BlockSpec(memory_space=_MEMORY_SPACE.ANY)
+
+    def row_block(shape):
+        return pl.BlockSpec(
+            (sb, *shape), lambda i: (i, *(0 for _ in shape))
+        )
+
+    staged = quant and dma
+    scratch = [
+        pltpu.VMEM((sb, hkv, dh, ctx_len), jnp.bfloat16),  # kctx
+        pltpu.VMEM((sb, hkv, dh, ctx_len), jnp.bfloat16),  # vctx
+        pltpu.VMEM((2, sb, pb, hkv, dh, page), jnp.int8) if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, dh, page), jnp.int8) if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, page), jnp.float32) if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, page), jnp.float32) if staged else None,
+        pltpu.SemaphoreType.DMA((4, 2, sb, pb)) if dma else None,
+    ]
+    in_specs = [
+        smem, smem, row_block((h, w, dh)), row_block((hkv, w, dh)),
+        row_block((hkv, w, dh)), hbm, hbm,
+    ]
+    args = [page_table, lens, q, k_chunk, v_chunk, k_pool, v_pool]
+    if quant:
+        in_specs += [hbm, hbm]
+        args += [k_scale, v_scale]
+
+    def kernel(table_ref, lens_ref, q_ref, kc_ref, vc_ref, kp_ref,
+               vp_ref, *rest):
+        kstage = vstage = ksstage = vsstage = sems = None
+        if quant:
+            ks_ref, vs_ref = rest[0], rest[1]
+            rest = rest[2:]
+        else:
+            ks_ref = vs_ref = None
+        if staged:
+            (o_ref, kctx, vctx, kstage, vstage, ksstage, vsstage,
+             sems) = rest
+        elif dma:
+            o_ref, kctx, vctx, sems = rest
+        else:
+            o_ref, kctx, vctx = rest
+        _chunk_kernel(
+            table_ref, lens_ref, q_ref, kc_ref, vc_ref, kp_ref, vp_ref,
+            ks_ref, vs_ref, o_ref, kctx, vctx, kstage, vstage, ksstage,
+            vsstage, sems, page=page, window=window, sb=sb, pb=pb,
+            max_pages=max_pages, live_pages=live_pages, ctx_len=ctx_len,
+            group=h // hkv, dma=dma,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid=(slots // sb,),
+        in_specs=in_specs,
+        out_specs=row_block((h, w, dh)),
+        out_shape=jax.ShapeDtypeStruct((slots, h, w, dh), jnp.bfloat16),
+        scratch_shapes=[s for s in scratch if s is not None],
+        interpret=interpret,
+    )(*args)
+
+
+def paged_chunk_attention(
+    q: jax.Array,
+    k_chunk: jax.Array,
+    v_chunk: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    page_table: jax.Array,
+    lens: jax.Array,
+    *,
+    ctx_len: int | None = None,
+    live_pages: int | None = None,
+    window: int | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    config: dict | None = None,
+) -> jax.Array:
+    """Fused chunk attention DIRECTLY against the paged pools: each
+    slot's ``W``-token query chunk (spec-verify drafts, or a
+    prefix-hit admission's suffix) attends the slot's committed pages
+    in place plus the chunk's own freshly projected (k, v) — replacing
+    the dense-gather transient the verify/prefix paths used to
+    materialize per layer (``(slots, Hkv, max_pages*page, Dh)`` in
+    HBM, written then read, dequantized BEFORE attention under int8
+    pools; the round-3 story all over again, one level up).
+
+    - ``q``: (S, H, W, Dh); row ``j`` of slot ``s`` sits at position
+      ``lens[s] + j`` and attends positions ``<= lens[s] + j`` (minus
+      anything at or before ``pos - window``).
+    - ``k_chunk``/``v_chunk``: (S, Hkv, W, Dh) — the chunk's own kv
+      projections (NOT yet in the pool; the kernel overlays them, so
+      verify needs no tentative pool writes at all).
+    - ``k_pool``/``v_pool``/``k_scale``/``v_scale``: the page pools,
+      bf16 or int8-with-scales, exactly as
+      :func:`paged_decode_attention` takes them; int8 dequantizes
+      inside the kernel, page at a time.
+    - ``page_table``: (S, P); ``lens``: (S,) committed tokens per slot.
+    - ``ctx_len``: static attention width — MUST equal the dense
+      oracle's buffer width for the bitwise contract (defaults to
+      ``P * page``, the spec-verify case; prefix-hit admission passes
+      ``P * page + W``).
+    - ``live_pages``: optional static bound on table columns moved
+      (None = the full table width; the TPU transport's DMA rounds
+      are dynamically gated on each slot's real length either way).
+      Bounding is TRAFFIC/code-size-only — the attention width stays
+      ``ctx_len`` and skipped columns are exact zeros behind the
+      mask, so values never change (see :class:`ChunkPagedInfo`).
+    - ``config``: explicit ``{slots_per_block, pages_per_block}``
+      override; by default the shape's autotuned entry
+      (:mod:`beholder_tpu.ops.autotune`) or its defaults. Block sizes
+      are numerics-neutral by construction — they move wall time only.
+
+    Returns (S, H, W, Dh) bf16, BITWISE-identical to running the dense
+    cache path over the gathered context (pinned by
+    ``tests/test_paged_chunk_kernel.py``); no ``(slots, ..,
+    max_pages*page, ..)`` buffer exists anywhere in the program — the
+    per-grid-step working set is ``slots_per_block/slots`` of it, in
+    VMEM."""
+    if q.ndim != 4:
+        raise ValueError(
+            f"q must be (slots, heads, width, head_dim), got {q.shape}"
+        )
+    slots, h, w, dh = q.shape
+    n, hkv, dh_p, page = k_pool.shape
+    if dh_p != dh:
+        raise ValueError(f"head_dim mismatch: q {dh} vs pool {dh_p}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {hkv}")
+    if k_chunk.shape != (slots, hkv, w, dh):
+        raise ValueError(
+            f"k_chunk must be {(slots, hkv, w, dh)}, got {k_chunk.shape}"
+        )
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(
+            f"pool shape mismatch: {k_pool.shape} vs {v_pool.shape}"
+        )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if k_scale is not None and k_scale.shape != (n, hkv, page):
+        raise ValueError(
+            f"scales must be {(n, hkv, page)}, got {k_scale.shape}"
+        )
+    if not _interpret() and page % 128:
+        raise ValueError(
+            f"page size {page} must be a multiple of 128 on TPU (pages "
+            f"are lane-aligned token columns; pick page_size=128)"
+        )
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    max_pages = page_table.shape[1]
+    if ctx_len is None:
+        ctx_len = max_pages * page
+    if ctx_len < max_pages * page:
+        raise ValueError(
+            f"ctx_len {ctx_len} cannot be narrower than the table span "
+            f"{max_pages * page}"
+        )
+    if live_pages is None:
+        live_pages = max_pages
+    if not 0 <= live_pages <= max_pages:
+        raise ValueError(
+            f"live_pages {live_pages} must be in [0, {max_pages}]"
+        )
+    from beholder_tpu.ops import autotune
+
+    dtype = "int8" if k_scale is not None else str(k_pool.dtype)
+    resolved = autotune.resolve_config(
+        autotune.shape_key(
+            "paged_chunk", slots=slots, width=w, max_pages=max_pages,
+            page=page, kv_heads=hkv, head_dim=dh, dtype=dtype,
+        ),
+        explicit=config,
+    )
+    sb, pb = autotune.normalize(resolved, slots, max_pages)
+    if _interpret() and not FORCE_PALLAS_INTERPRET:
+        # non-TPU backends take the portable block-streamed transport
+        # (see _chunk_reference); the pallas body stays test-covered
+        # through the FORCE_PALLAS_INTERPRET(_DMA) flags
+        return _chunk_reference(
+            q, k_chunk, v_chunk, k_pool, v_pool,
+            page_table.astype(jnp.int32), lens.astype(jnp.int32),
+            k_scale, v_scale, ctx_len=int(ctx_len),
+            live_pages=int(live_pages), window=window, sb=sb,
+        )
+    return _chunk_call(
+        q, k_chunk, v_chunk, k_pool, v_pool,
+        page_table.astype(jnp.int32), lens.astype(jnp.int32), k_scale,
+        v_scale, ctx_len=int(ctx_len), live_pages=int(live_pages),
+        window=window, sb=sb, pb=pb, interpret=_interpret(),
+        dma=not _interpret() or FORCE_PALLAS_INTERPRET_DMA,
     )

@@ -232,6 +232,25 @@ class BeholderService:
 
         self.flight_recorder = flight_recorder_from_config(config)
 
+        #: fused paged verify/prefix attention
+        #: (``instance.serving.fused_verify``; OFF by default) plus the
+        #: kernel autotune table location
+        #: (``instance.serving.autotune.table``; None = the committed
+        #: artifacts/autotune_paged.json). Library knobs like ``spec``:
+        #: the service parses them once for whatever embeds a
+        #: ContinuousBatcher
+        #: (``ContinuousBatcher(fused_verify=service.fused_verify,
+        #: autotune_table=service.autotune_table)``). Parsing is
+        #: import-light (no jax); off, serving output and the default
+        #: exposition stay byte-identical — the fused kernel is pinned
+        #: bitwise against the dense-gather oracle either way.
+        self.fused_verify = bool(
+            config.get("instance.serving.fused_verify", False)
+        )
+        self.autotune_table = config.get(
+            "instance.serving.autotune.table", None
+        )
+
         #: optional request-level SLO engine (``instance.slo.*``; OFF
         #: by default ⇒ serving output and the default exposition stay
         #: byte-identical, same contract as cache/spec/cluster). The

@@ -208,16 +208,54 @@ def _run_spec_loop(
 
     status_eye = np.eye(NUM_STATUSES, dtype=np.float32)
 
-    verify_fn = batcher._cached_jit(
-        ("spec_verify", w),
-        lambda: lambda p, s, f, a: spec_verify_step(
-            batcher.model, p, s, f, a
-        ),
-    )
-    rollback_fn = batcher._cached_jit(
-        ("spec_rollback",),
-        lambda: lambda s, nl, a: paged_rollback(s, nl, a),
-    )
+    # fused vs dense-gather verify: the FUSED round is ONE dispatched
+    # program (spec_verify_commit — commit the previous round's
+    # accepted prefix, then attend the paged pools in place through
+    # the fused chunk kernel; no dense per-layer gather, no tentative
+    # writes, nothing to roll back) where the dense round is a verify
+    # dispatch plus a rollback dispatch. Bitwise the same tokens
+    # either way (pinned by tests/test_paged_chunk_kernel.py); the
+    # dense path stays the reference oracle behind the batcher's
+    # fused_verify knob.
+    fused = bool(getattr(batcher, "fused_verify", False))
+    if fused:
+        from beholder_tpu.spec.verify import spec_verify_commit
+
+        # ONE compiled program per chunk width — the kernel's page
+        # walk is runtime-bounded by each slot's real length (its
+        # pl.when-guarded rounds skip dead pages dynamically), so no
+        # per-occupancy specialization is needed and a growing
+        # sequence never triggers a mid-run recompile
+        verify_fused_fn = batcher._cached_jit(
+            ("spec_verify_fused", w),
+            lambda: lambda p, s, f, kv, acc: spec_verify_commit(
+                batcher.model, p, s, f, kv, acc
+            ),
+        )
+
+        # the deferred-commit carry: last round's kv chunks + how many
+        # columns each slot keeps (0 = first round / inactive /
+        # RETIRED — a retiring slot's final chunk is never committed,
+        # so KV nobody will attend is never written)
+        hkv = batcher.model.kv_heads or batcher.model.heads
+        dh = batcher.model.dim // batcher.model.heads
+        zero_kv = jnp.zeros((slots, hkv, w, dh), jnp.bfloat16)
+        pending_kvs = tuple((zero_kv, zero_kv) for _ in range(
+            batcher.model.layers
+        ))
+        pending_accepts = np.zeros(slots, np.int64)
+        verify_fn = rollback_fn = None
+    else:
+        verify_fn = batcher._cached_jit(
+            ("spec_verify", w),
+            lambda: lambda p, s, f, a: spec_verify_step(
+                batcher.model, p, s, f, a
+            ),
+        )
+        rollback_fn = batcher._cached_jit(
+            ("spec_rollback",),
+            lambda: lambda s, nl, a: paged_rollback(s, nl, a),
+        )
 
     def free_pages() -> int:
         cold = (
@@ -333,10 +371,14 @@ def _run_spec_loop(
                     s_len = t - t_hit
                     s_pad = -(-s_len // page) * page
                     admit_c = batcher._cached_jit(
-                        ("spec_admit_cached", len(hit_pages), s_pad),
+                        (
+                            "spec_admit_cached", len(hit_pages), s_pad,
+                            fused,
+                        ),
                         lambda: lambda p, s, sl, f, ln, pg: (
                             paged_admit_with_prefix(
-                                batcher.model, p, s, sl, f, ln, pg
+                                batcher.model, p, s, sl, f, ln, pg,
+                                fused=fused,
                             )
                         ),
                     )
@@ -429,22 +471,38 @@ def _run_spec_loop(
         verify_tags = {"slots": int(active.sum())}
         if fr is not None and active.any():
             # each live slot scores a (k+1)-wide chunk against its
-            # paged context — the "verify" kernel family
+            # paged context — the "verify" kernel family, or
+            # "paged_chunk" when the fused kernel serves it (its own
+            # roofline family: the flight recorder and perf gate see
+            # the fused kernel's achieved ceiling fraction separately)
             verify_tags.update(batcher._kernel_tags(
-                "verify",
+                "paged_chunk" if fused else "verify",
                 float(active.sum()) * w * batcher._flops_per_token(
                     float(cache_len[active].mean())
                 ),
             ))
         with batcher._round(span, "verify", **verify_tags):
-            preds_dev, batcher.state = verify_fn(
-                batcher.params, batcher.state, jnp.asarray(chunk),
-                jnp.asarray(active),
-            )
+            if fused:
+                # the round's ONE dispatch: commit last round's
+                # accepted columns, verify this round's chunk. The
+                # packed readback below also reads the commit's
+                # allocator flag — every allocating dispatch stays
+                # covered by the safety net.
+                preds_dev, pending_kvs, batcher.state = verify_fused_fn(
+                    batcher.params, batcher.state, jnp.asarray(chunk),
+                    pending_kvs,
+                    jnp.asarray(pending_accepts, jnp.int32),
+                )
+            else:
+                preds_dev, batcher.state = verify_fn(
+                    batcher.params, batcher.state, jnp.asarray(chunk),
+                    jnp.asarray(active),
+                )
             preds = fetch_packed([preds_dev]).reshape(slots, w)
 
-        # -- host acceptance + rollback lengths
+        # -- host acceptance + rollback/commit lengths
         new_lens = np.zeros(slots, np.int64)
+        accepts = np.zeros(slots, np.int64)
         done = []
         for slot in range(slots):
             if req_of[slot] is None:
@@ -462,7 +520,15 @@ def _run_spec_loop(
                 )
             old_end = cache_len[slot] + w
             new_lens[slot] = cache_len[slot] + m + 1
-            freed = (-(-old_end // page)) - (-(-new_lens[slot] // page))
+            accepts[slot] = m + 1
+            # the fused path never wrote the rejected suffix, so there
+            # is nothing to free; the dense path reclaims the pages its
+            # tentative W-token writes opened past the accepted end
+            freed = (
+                0
+                if fused
+                else (-(-old_end // page)) - (-(-new_lens[slot] // page))
+            )
             history[slot].extend(float(x) for x in toks)
             emitted[slot].extend(float(x) for x in toks)
             cache_len[slot] = new_lens[slot]
@@ -491,11 +557,21 @@ def _run_spec_loop(
                 drafter.resync(
                     slot, np.asarray(history[slot], np.float32)
                 )
-        with batcher._round(span, "rollback", slots=int(active.sum())):
-            batcher.state = rollback_fn(
-                batcher.state, jnp.asarray(new_lens, jnp.int32),
-                jnp.asarray(active),
-            )
+        if fused:
+            # no reconciliation dispatch at all: the accepted columns
+            # commit at the START of the next round's verify program
+            # (spec_verify_commit), and a RETIRING slot's final chunk
+            # is dropped — KV nobody will ever attend is never
+            # written, its pages never popped (release below frees
+            # exactly what was committed)
+            accepts[done] = 0
+            pending_accepts = accepts
+        else:
+            with batcher._round(span, "rollback", slots=int(active.sum())):
+                batcher.state = rollback_fn(
+                    batcher.state, jnp.asarray(new_lens, jnp.int32),
+                    jnp.asarray(active),
+                )
         if done:
             retire(done)
             if batcher._metrics:
@@ -504,11 +580,14 @@ def _run_spec_loop(
                 )
                 batcher._metrics.pool_pages_free.set(free_pages())
 
-    # no trailing allocator check: every ALLOCATING dispatch (admit,
-    # verify) is immediately followed by a fetch_packed() that reads
+    # no trailing allocator check in EITHER mode: every ALLOCATING
+    # dispatch (admit, dense verify, the fused round's in-program
+    # commit) is immediately followed by a fetch_packed() that reads
     # the sticky flag, and the only later dispatches (rollback,
     # release) can only free pages — a final device_get would buy
-    # nothing and cost one d2h sync (~65 ms on the tunnel) per call
+    # nothing and cost one d2h sync (~65 ms on the tunnel) per call.
+    # (The fused path's LAST chunk per slot is never committed at all:
+    # retiring slots drop it, so no pops ever go unobserved.)
     if batcher._metrics:
         batcher._metrics.served(*served)
     return results

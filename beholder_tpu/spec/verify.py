@@ -53,7 +53,11 @@ from beholder_tpu.models.serving import (
     _pop_pages,
     _unref_pages,
 )
-from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
+from beholder_tpu.ops.paged_attention import (
+    ChunkPagedInfo,
+    PagedInfo,
+    QuantizedPool,
+)
 
 
 def _gather_dense(pool, page_table: jax.Array) -> jax.Array:
@@ -146,6 +150,160 @@ def spec_verify_step(
         seq_lens=lens + w * active.astype(jnp.int32),
     )
     return preds, state
+
+
+def spec_verify_chunk(
+    model,
+    params,
+    state: PagedKVState,
+    chunk_feats: jax.Array,
+    live_pages: int | None = None,
+):
+    """FUSED verify: score one ``(slots, W, F)`` chunk against every
+    slot's paged context through :func:`~beholder_tpu.ops.
+    paged_attention.paged_chunk_attention` — READ-ONLY. No pages pop,
+    no kv scatters, no ``seq_lens`` advance: the chunk attends the
+    pools in place and its own kv stays in the returned per-layer
+    ``(slots, Hkv, W, Dh)`` chunk tensors. The host accepts a prefix
+    and :func:`spec_commit_step` then writes EXACTLY the accepted
+    columns — so rejected drafts never touch the pool, there is
+    nothing to roll back, and the worst-case page budget drops by the
+    ``max_draft`` transient :func:`spec_verify_step` must reserve
+    (``ContinuousBatcher._need_pages`` — the capacity lever).
+
+    ``live_pages`` (static, optional) additionally bounds the table
+    columns the kernel may touch; the scheduler leaves it None — ONE
+    compiled program per chunk width, with page traffic already
+    runtime-bounded by each slot's real length inside the kernel
+    (the dense path instead always gathers the whole table span).
+    Traffic/code-size-only — attention width and values are unchanged
+    (see :class:`~beholder_tpu.ops.paged_attention.ChunkPagedInfo`).
+
+    Bitwise contract: the predictions are bit-identical to
+    :func:`spec_verify_step`'s on the same state (the kernel runs the
+    dense oracle's op sequence at the dense oracle's width; pinned by
+    ``tests/test_paged_chunk_kernel.py``), so flipping the
+    ``fused_verify`` knob cannot change a single served token.
+
+    Returns ((slots, W) predictions, per-layer ((k, v)) chunk tuples).
+    """
+    _, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    s, w, _ = chunk_feats.shape
+    if s != slots:
+        raise ValueError(f"chunk batch {s} != slots {slots}")
+    info = ChunkPagedInfo(
+        state.page_table, state.seq_lens, max_pages * page, live_pages
+    )
+    preds, kvs = model.apply(
+        params, chunk_feats,
+        cache=(state.k_pools, state.v_pools, info),
+    )
+    return preds, kvs
+
+
+def spec_verify_commit(
+    model,
+    params,
+    state: PagedKVState,
+    chunk_feats: jax.Array,
+    prev_kvs,
+    prev_accepts: jax.Array,
+    live_pages: int | None = None,
+):
+    """One fused round as ONE dispatched program: commit the PREVIOUS
+    round's accepted prefix (:func:`spec_commit_step` — pops, pool
+    scatters and the ``seq_lens`` advance for exactly the tokens the
+    host kept), then score this round's chunk against the
+    just-committed context (:func:`spec_verify_chunk`). The dense
+    path's round is a verify dispatch plus a rollback dispatch; the
+    fused round is this single program — the transparent-operation-
+    fusion shape of the whole scheduler step.
+
+    Deferring the commit one round is free: the committed tokens are
+    first ATTENDED by the next round's verify, which is exactly where
+    the commit now runs, and a slot that RETIRES simply never commits
+    its final chunk (``prev_accepts[s] = 0``) — KV nobody will ever
+    attend is never written and its pages are never popped. The
+    sticky allocator flag from the commit's pops is read by this same
+    round's packed readback, so the host's safety net sees every
+    allocating dispatch with no extra sync.
+
+    ``prev_accepts[s] == 0`` marks "nothing to commit" (first round,
+    inactive, or retired); the zero-filled first-round ``prev_kvs``
+    ride the same compiled program. Returns ((slots, W) predictions,
+    this round's per-layer kv chunks, state)."""
+    accepts = jnp.asarray(prev_accepts, jnp.int32)
+    state = spec_commit_step(state, prev_kvs, accepts, accepts > 0)
+    preds, kvs = spec_verify_chunk(
+        model, params, state, chunk_feats, live_pages=live_pages
+    )
+    return preds, kvs, state
+
+
+def spec_commit_step(
+    state: PagedKVState,
+    kvs,
+    accepts: jax.Array,
+    active: jax.Array,
+) -> PagedKVState:
+    """Commit one fused verify round's ACCEPTED prefix: pop pages for
+    the ``accepts[s]`` tokens slot ``s`` keeps (``m + 1`` — the
+    accepted drafts plus the bonus/correction position; 0 for
+    inactive slots), scatter exactly those chunk kv columns through
+    the same :func:`~beholder_tpu.models.sequence._pool_write_column`
+    cast/quantize path every other pool write uses, and advance
+    ``seq_lens`` by the accepted count. The committed pool bytes are
+    bitwise what :func:`spec_verify_step`'s scatter-then-rollback
+    leaves at the same positions; the difference is that rejected
+    columns were never written, so no page is ever popped for a token
+    that does not survive — the allocator's worst case follows
+    ACCEPTED tokens (bounded by the horizon: the scheduler clamps
+    drafts to the remaining horizon), not the draft width."""
+    num_pages, page = _pool_geometry(state)
+    slots, max_pages = state.page_table.shape
+    w = kvs[0][0].shape[2]
+    lens = state.seq_lens
+    accepts = jnp.asarray(accepts, jnp.int32)
+    pos = lens[:, None] + jnp.arange(w)              # (S, W) positions
+    keep = active[:, None] & (jnp.arange(w)[None, :] < accepts[:, None])
+    need = keep & (pos % page == 0)
+    pages, new_top, ref, failed = _pop_pages(state, need.reshape(-1))
+    pages = pages.reshape(slots, w)
+    pidx = pos // page
+    failed = failed | jnp.any(need & (pidx >= max_pages))
+    rows = jnp.where(need, jnp.arange(slots)[:, None], slots)
+    table = state.page_table.at[
+        rows, jnp.clip(pidx, 0, max_pages - 1)
+    ].set(pages, mode="drop")
+    state = state._replace(
+        page_table=table, free_top=new_top, page_ref=ref,
+        alloc_failed=failed,
+    )
+
+    write_pages = jnp.where(
+        keep,
+        table[jnp.arange(slots)[:, None], jnp.clip(pidx, 0, max_pages - 1)],
+        num_pages,                                   # OOB -> dropped write
+    ).reshape(-1)
+    info = PagedInfo(table, lens, write_pages, (pos % page).reshape(-1))
+    k_pools, v_pools = [], []
+    for layer, (k_chunk, v_chunk) in enumerate(kvs):
+        def cols(a):
+            # (S, Hkv, W, Dh) -> the chunk's columns (S*W, Hkv, Dh) —
+            # the same per-column values spec_verify_step extracts
+            # from its dense kv output at the chunk positions
+            return a.transpose(0, 2, 1, 3).reshape(
+                slots * w, a.shape[1], a.shape[3]
+            )
+        k_pools.append(_pool_write_column(state.k_pools[layer], info, cols(k_chunk)))
+        v_pools.append(_pool_write_column(state.v_pools[layer], info, cols(v_chunk)))
+
+    return state._replace(
+        k_pools=tuple(k_pools),
+        v_pools=tuple(v_pools),
+        seq_lens=lens + jnp.where(active, accepts, 0),
+    )
 
 
 def paged_rollback(

@@ -27,6 +27,10 @@ metric                      why it survives host drift                fails
                             speed divides out
 ``slo_attainment``          fraction of requests inside every         lower
                             latency objective — request accounting
+``fused_verify_ratio``      fused verify-round wall / dense-gather    higher
+                            verify-round wall, slope-timed
+                            interleaved in the same session — host
+                            speed divides out
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -102,6 +106,12 @@ NOISE_BANDS: dict[str, float] = {
     # committed baseline's objectives are sized so healthy CI runs sit
     # at/near 1.0, making any material drop a real scheduling change
     "slo_attainment": 0.10,
+    # fused/dense verify-round wall (schema v9): both sides slope-timed
+    # INTERLEAVED in the same session, so host drift divides out — what
+    # the band must catch is the fused path losing its edge (the ratio
+    # rising back toward/past the dense oracle), not scheduler jitter
+    # around the committed value
+    "fused_verify_ratio": 0.40,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -192,6 +202,13 @@ def _slo_attainment(artifact: dict) -> float | None:
     return float(value)
 
 
+def _fused_verify_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "kernel", "fused_verify_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v9 artifact / kernel scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -211,6 +228,9 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     ("ttft_tail_ratio", _ttft_tail_ratio, "higher"),
     # objective attainment: degradation is the fraction FALLING
     ("slo_attainment", _slo_attainment, "lower"),
+    # fused/dense verify wall: a fused-kernel regression shows as the
+    # ratio RISING back toward the dense-gather cost
+    ("fused_verify_ratio", _fused_verify_ratio, "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -247,6 +267,16 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     # (the gated figures are the tail ratio and attainment above)
     ("slo_ttft_p50_ms", lambda a: _get(a, "slo", "ttft_p50_ms")),
     ("slo_tpot_p50_ms", lambda a: _get(a, "slo", "tpot_p50_ms")),
+    # absolute kernel walls behind fused_verify_ratio: host-speed-
+    # dependent, reported only
+    (
+        "kernel_fused_verify_wall_s",
+        lambda a: _get(a, "kernel", "fused_verify_wall_s"),
+    ),
+    (
+        "kernel_dense_verify_wall_s",
+        lambda a: _get(a, "kernel", "dense_verify_wall_s"),
+    ),
 ]
 
 

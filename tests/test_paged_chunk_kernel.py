@@ -1,0 +1,1005 @@
+"""Fused paged chunk-attention kernel (ops/paged_attention.py
+``paged_chunk_attention`` + the verify/prefix rewires behind
+``ContinuousBatcher(fused_verify=True)``): bitwise identity against
+the dense-gather oracle across all three transports (portable XLA
+twin, pallas-interpret body, and through the serving engines), the
+no-dense-transient jaxpr contract, the verify page-budget capacity
+gain, the block-size autotuner, and the v9 artifact/perf-gate legs.
+
+Marked ``kernel`` (dedicated CI step, interpret-mode on CPU). Models
+are deliberately tiny — the claims are numerics, allocator invariants
+and scheduling, not kernel speed (bench.py --kernel-only owns the
+walls).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.cache import PrefixCache
+from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+from beholder_tpu.models.serving import (
+    ContinuousBatcher,
+    Request,
+    init_paged,
+    paged_admit_batch,
+    paged_admit_with_prefix,
+    paged_fork,
+)
+from beholder_tpu.ops import autotune
+from beholder_tpu.ops import paged_attention as pa
+from beholder_tpu.ops.paged_attention import (
+    QuantizedPool,
+    paged_chunk_attention,
+)
+from beholder_tpu.proto import TelemetryStatusEntry
+from beholder_tpu.spec import SpecConfig
+from beholder_tpu.spec.drafter import Drafter, NullDrafter
+from beholder_tpu.spec.verify import (
+    spec_commit_step,
+    spec_verify_chunk,
+    spec_verify_step,
+)
+from beholder_tpu.tools.perf_gate import run_gate
+
+pytestmark = pytest.mark.kernel
+
+PAGE = 8
+STATUS = int(TelemetryStatusEntry.CONVERTING)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = TelemetrySequenceModel(dim=32, heads=4, kv_heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state.params
+
+
+@pytest.fixture(autouse=True)
+def _pristine_autotune():
+    """Every test starts from the default table resolution and leaves
+    no configure() residue behind."""
+    autotune.configure(None)
+    yield
+    autotune.configure(None)
+
+
+def _request(seed, deltas=2 * PAGE, horizon=9):
+    rng = np.random.default_rng(seed)
+    prog = np.cumsum(1.0 + rng.normal(0, 0.05, deltas + 1))
+    return Request(prog, np.full(deltas + 1, STATUS), horizon)
+
+
+def _batcher(model, params, num_pages=48, slots=2, **kw):
+    return ContinuousBatcher(
+        model, params, num_pages=num_pages, page_size=PAGE, slots=slots,
+        max_prefix=24, max_pages_per_seq=16, **kw,
+    )
+
+
+# -- the kernel vs the dense oracle, directly --------------------------------
+
+
+def _dense_oracle(q, kc, vc, k_pool, v_pool, table, lens, *, ctx_len,
+                  window=None, k_scale=None, v_scale=None):
+    """The dense-gather reference computation, op for op what
+    spec/verify.py's ``_gather_dense`` + models/sequence.py's
+    vector-index t>1 cache branch compute."""
+    s, h, w, dh = q.shape
+    hkv = k_pool.shape[1]
+    g_heads = h // hkv
+    page = k_pool.shape[3]
+    max_pages = table.shape[1]
+
+    def gather(pool, scales):
+        if scales is not None:
+            vals = (
+                pool.astype(jnp.float32) * scales[:, :, None, :]
+            ).astype(jnp.bfloat16)
+        else:
+            vals = pool.astype(jnp.bfloat16)
+        gath = vals[table]                     # (S, P, Hkv, Dh, page)
+        ctx = gath.transpose(0, 2, 1, 4, 3).reshape(
+            s, hkv, max_pages * page, dh
+        )
+        if ctx_len > max_pages * page:
+            ctx = jnp.concatenate(
+                [
+                    ctx,
+                    jnp.zeros(
+                        (s, hkv, ctx_len - max_pages * page, dh),
+                        jnp.bfloat16,
+                    ),
+                ],
+                axis=2,
+            )
+        return ctx
+
+    k_cache = gather(k_pool, k_scale)
+    v_cache = gather(v_pool, v_scale)
+    rows = jnp.arange(s)
+    pos_w = lens[:, None] + jnp.arange(w)
+    k_cache = k_cache.at[rows[:, None], :, pos_w, :].set(
+        kc.transpose(0, 2, 1, 3).astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[rows[:, None], :, pos_w, :].set(
+        vc.transpose(0, 2, 1, 3).astype(v_cache.dtype), mode="drop"
+    )
+    qg = q.astype(k_cache.dtype).reshape(s, hkv, g_heads, w, dh)
+    scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k_cache) / jnp.sqrt(
+        jnp.float32(dh)
+    )
+    positions = jnp.arange(ctx_len)
+    live = positions[None, None, :] <= pos_w[:, :, None]
+    if window is not None:
+        live = live & (
+            positions[None, None, :] > pos_w[:, :, None] - window
+        )
+    scores = jnp.where(live[:, None, None, :, :], scores, -1e30)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum(
+        "bhgqk,bhkd->bhgqd", weights.astype(q.dtype), v_cache
+    ).reshape(s, h, w, dh)
+
+
+def _kernel_inputs(seed, *, slots=4, hkv=2, g=2, w=4, dh=16, page=PAGE,
+                   max_pages=8, num_pages=32, quant=False):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 8)
+    h = hkv * g
+    q = jax.random.normal(keys[0], (slots, h, w, dh), jnp.bfloat16)
+    kc = jax.random.normal(keys[1], (slots, hkv, w, dh), jnp.bfloat16)
+    vc = jax.random.normal(keys[2], (slots, hkv, w, dh), jnp.bfloat16)
+    table = jax.random.randint(
+        keys[3], (slots, max_pages), 0, num_pages, jnp.int32
+    )
+    lens = jax.random.randint(
+        keys[4], (slots,), 0, max_pages * page - w, jnp.int32
+    )
+    if quant:
+        kp = jax.random.randint(
+            keys[5], (num_pages, hkv, dh, page), -127, 128, jnp.int8
+        )
+        vp = jax.random.randint(
+            keys[6], (num_pages, hkv, dh, page), -127, 128, jnp.int8
+        )
+        ks = jax.random.uniform(
+            keys[7], (num_pages, hkv, page), jnp.float32, 0.001, 0.1
+        )
+        return q, kc, vc, kp, vp, table, lens, ks, ks
+    kp = jax.random.normal(
+        keys[5], (num_pages, hkv, dh, page), jnp.bfloat16
+    )
+    vp = jax.random.normal(
+        keys[6], (num_pages, hkv, dh, page), jnp.bfloat16
+    )
+    return q, kc, vc, kp, vp, table, lens, None, None
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+def test_kernel_bitwise_vs_dense_oracle(quant):
+    """THE kernel contract: paged_chunk_attention == the dense-gather
+    oracle BITWISE (np.array_equal, not allclose) — GQA, random
+    tables, random per-row offsets, bf16 and int8 pools."""
+    for seed in range(4):
+        q, kc, vc, kp, vp, table, lens, ks, vs = _kernel_inputs(
+            seed, quant=quant
+        )
+        got = paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, k_scale=ks, v_scale=vs
+        )
+        want = jax.jit(
+            lambda q, kc, vc, kp, vp, t, ln: _dense_oracle(
+                q, kc, vc, kp, vp, t, ln, ctx_len=table.shape[1] * PAGE,
+                k_scale=ks, v_scale=vs,
+            )
+        )(q, kc, vc, kp, vp, table, lens)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want), err_msg=f"seed {seed}"
+        )
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+def test_pallas_transport_matches_reference(monkeypatch, quant):
+    """The pallas kernel body (what a real TPU compiles, run here in
+    interpreter mode via FORCE_PALLAS_INTERPRET) is bitwise the
+    portable reference transport — the two share _chunk_block_math,
+    and the assembly stages must agree too."""
+    q, kc, vc, kp, vp, table, lens, ks, vs = _kernel_inputs(
+        7, quant=quant
+    )
+    ref = np.asarray(
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, k_scale=ks, v_scale=vs
+        )
+    )
+    monkeypatch.setattr(pa, "FORCE_PALLAS_INTERPRET", True)
+    got = np.asarray(
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, k_scale=ks, v_scale=vs
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("windowed", [False, True], ids=["full", "window"])
+def test_pallas_dma_assembly_matches_reference(monkeypatch, quant,
+                                               windowed):
+    """The kernel's REAL assembly stage — zeroed VMEM scratch + the
+    1-ahead double-buffered make_async_copy rounds + the post-wait
+    int8 stage/dequant (what a real TPU compiles) — pinned bitwise
+    through the interpreter via FORCE_PALLAS_INTERPRET_DMA. The plain
+    FORCE_PALLAS_INTERPRET test above covers the math stages with a
+    value-gather shortcut; this one drives the DMA pipeline itself
+    (~50 us/descriptor interpreted, so a tiny pool)."""
+    q, kc, vc, kp, vp, table, lens, ks, vs = _kernel_inputs(
+        11, slots=2, max_pages=4, num_pages=8, quant=quant
+    )
+    window = 11 if windowed else None
+    ref = np.asarray(
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, k_scale=ks, v_scale=vs,
+            window=window,
+        )
+    )
+    monkeypatch.setattr(pa, "FORCE_PALLAS_INTERPRET", True)
+    monkeypatch.setattr(pa, "FORCE_PALLAS_INTERPRET_DMA", True)
+    got = np.asarray(
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, k_scale=ks, v_scale=vs,
+            window=window,
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_kernel_window_matches_dense_oracle():
+    q, kc, vc, kp, vp, table, lens, _, _ = _kernel_inputs(3)
+    got = paged_chunk_attention(q, kc, vc, kp, vp, table, lens, window=11)
+    want = jax.jit(
+        lambda *a: _dense_oracle(
+            *a, ctx_len=table.shape[1] * PAGE, window=11
+        )
+    )(q, kc, vc, kp, vp, table, lens)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_live_pages_bound_is_traffic_only():
+    """Bounding the pages the kernel moves must never change a value:
+    masked lanes are exact zeros either way."""
+    q, kc, vc, kp, vp, table, lens, _, _ = _kernel_inputs(5)
+    lens = jnp.minimum(lens, 3 * PAGE - 4)  # live span inside 3 pages
+    full = paged_chunk_attention(q, kc, vc, kp, vp, table, lens)
+    bounded = paged_chunk_attention(
+        q, kc, vc, kp, vp, table, lens, live_pages=4
+    )
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(bounded))
+
+
+def test_block_size_config_is_numerics_neutral():
+    """Every autotuner candidate yields the same bits — block sizes
+    move wall time only (the search space is numerics-neutral by
+    construction)."""
+    q, kc, vc, kp, vp, table, lens, _, _ = _kernel_inputs(9)
+    base = np.asarray(
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens,
+            config={"slots_per_block": 1, "pages_per_block": 1},
+        )
+    )
+    for cfg in autotune.candidate_configs(4, 8):
+        got = paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, config=cfg
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), base, err_msg=str(cfg)
+        )
+
+
+def test_kernel_validation_errors():
+    q, kc, vc, kp, vp, table, lens, _, _ = _kernel_inputs(0)
+    with pytest.raises(ValueError, match="slots, heads"):
+        paged_chunk_attention(q[0], kc, vc, kp, vp, table, lens)
+    with pytest.raises(ValueError, match="k_chunk"):
+        paged_chunk_attention(
+            q, kc[:, :, :1], vc, kp, vp, table, lens
+        )
+    with pytest.raises(ValueError, match="given together"):
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens,
+            k_scale=jnp.ones((32, 2, PAGE)),
+        )
+    with pytest.raises(ValueError, match="ctx_len"):
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, ctx_len=PAGE
+        )
+    with pytest.raises(ValueError, match="live_pages"):
+        paged_chunk_attention(
+            q, kc, vc, kp, vp, table, lens, live_pages=99
+        )
+
+
+# -- the verify rewire -------------------------------------------------------
+
+
+def _admitted_state(model, params, slots=2, num_pages=48, lens_tokens=12,
+                    cache_dtype=jnp.bfloat16):
+    state = init_paged(
+        model, num_pages, PAGE, slots, 16, cache_dtype=cache_dtype
+    )
+    feats = jnp.asarray(
+        np.random.default_rng(0).normal(
+            size=(slots, 2 * PAGE, 7)
+        ).astype(np.float32)
+    )
+    _, state = paged_admit_batch(
+        model, params, state, jnp.arange(slots, dtype=jnp.int32),
+        feats, jnp.full((slots,), lens_tokens, jnp.int32),
+    )
+    return state
+
+
+@pytest.mark.parametrize(
+    "cache_dtype", [jnp.bfloat16, "int8"], ids=["bf16", "int8"]
+)
+def test_verify_chunk_preds_bitwise_vs_verify_step(
+    model_and_params, cache_dtype
+):
+    """The read-only fused verify scores the chunk bit-identically to
+    the dense-gather verify program on the same state."""
+    model, params = model_and_params
+    state = _admitted_state(model, params, cache_dtype=cache_dtype)
+    chunk = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 4, 7)).astype(np.float32)
+    )
+    active = jnp.ones((2,), bool)
+    dense_preds, _ = jax.jit(
+        lambda p, s, f, a: spec_verify_step(model, p, s, f, a)
+    )(params, state, chunk, active)
+    fused_preds, kvs = jax.jit(
+        lambda p, s, f: spec_verify_chunk(model, p, s, f)
+    )(params, state, chunk)
+    np.testing.assert_array_equal(
+        np.asarray(dense_preds), np.asarray(fused_preds)
+    )
+    assert kvs[0][0].shape == (2, 2, 4, 8)  # (S, Hkv, W, Dh) chunks
+
+
+def test_commit_writes_match_dense_scatter(model_and_params):
+    """Committing the accepted prefix leaves the pool bytes the
+    dense path's scatter wrote at the same positions, pops the same
+    number of pages as survive its rollback, and advances seq_lens
+    identically."""
+    model, params = model_and_params
+    state = _admitted_state(model, params, lens_tokens=PAGE + 3)
+    chunk = jnp.asarray(
+        np.random.default_rng(2).normal(size=(2, 4, 7)).astype(np.float32)
+    )
+    active = jnp.ones((2,), bool)
+    accepts = jnp.asarray([3, 1], jnp.int32)
+
+    from beholder_tpu.spec.verify import paged_rollback
+
+    preds, dense_state = jax.jit(
+        lambda p, s, f, a: spec_verify_step(model, p, s, f, a)
+    )(params, state, chunk, active)
+    dense_state = jax.jit(paged_rollback)(
+        dense_state, state.seq_lens + accepts, active
+    )
+
+    _, kvs = jax.jit(
+        lambda p, s, f: spec_verify_chunk(model, p, s, f)
+    )(params, state, chunk)
+    fused_state = jax.jit(spec_commit_step)(state, kvs, accepts, active)
+
+    np.testing.assert_array_equal(
+        np.asarray(dense_state.seq_lens), np.asarray(fused_state.seq_lens)
+    )
+    assert int(dense_state.free_top) == int(fused_state.free_top)
+    # committed positions hold identical bytes (page ids may differ —
+    # pages are interchangeable — so compare through each table)
+    from beholder_tpu.models.serving import slot_cache
+
+    for slot in range(2):
+        for layer in range(model.layers):
+            dk, dv = slot_cache(dense_state, slot, layer)
+            fk, fv = slot_cache(fused_state, slot, layer)
+            np.testing.assert_array_equal(np.asarray(dk), np.asarray(fk))
+            np.testing.assert_array_equal(np.asarray(dv), np.asarray(fv))
+
+
+class LyingDrafter(Drafter):
+    def propose(self, slot, history, k):
+        return np.asarray(
+            [float(history[-1]) + 0.37 * (i + 1) for i in range(k)],
+            np.float32,
+        )
+
+
+@pytest.mark.parametrize(
+    "cache_dtype", [jnp.bfloat16, "int8"], ids=["bf16", "int8"]
+)
+@pytest.mark.parametrize(
+    "drafter", ["ngram", LyingDrafter()], ids=["ngram", "lying"],
+)
+def test_fused_spec_streams_bitwise_identical(
+    model_and_params, cache_dtype, drafter
+):
+    """THE serving acceptance test: exact-greedy spec serving with the
+    fused kernel ON emits the same token stream as the dense-gather
+    path, np.array_equal, bf16 AND int8, regardless of drafter quality
+    — and both pools come home."""
+    model, params = model_and_params
+    reqs = [_request(i, horizon=9) for i in range(3)]
+    dense = _batcher(
+        model, params, cache_dtype=cache_dtype,
+        spec=SpecConfig(max_draft=3, drafter=drafter),
+    ).run_spec(reqs)
+    b = _batcher(
+        model, params, cache_dtype=cache_dtype,
+        spec=SpecConfig(max_draft=3, drafter=drafter), fused_verify=True,
+    )
+    fused = b.run_spec(reqs)
+    for i in range(len(reqs)):
+        np.testing.assert_array_equal(
+            fused[i], dense[i], err_msg=f"request {i}"
+        )
+    assert int(b.state.free_top) == b.num_pages  # no page leaked
+
+
+def test_fused_spec_unaligned_prefixes_ulp_bounded(model_and_params):
+    """Non-page-aligned prefixes: the fused stream tracks the dense
+    stream within reassociation ULPs (the contract the ISSUE pins for
+    unaligned shapes; on this host it is in fact bitwise, and the
+    tolerance guards XLA reassociation differences across versions)."""
+    model, params = model_and_params
+    reqs = [_request(i, deltas=12, horizon=8) for i in range(2)]
+    dense = _batcher(
+        model, params, spec=SpecConfig(max_draft=3)
+    ).run_spec(reqs)
+    fused = _batcher(
+        model, params, spec=SpecConfig(max_draft=3), fused_verify=True
+    ).run_spec(reqs)
+    for i in range(len(reqs)):
+        np.testing.assert_allclose(
+            fused[i], dense[i], rtol=1e-6, atol=1e-6,
+            err_msg=f"request {i}",
+        )
+
+
+def test_fused_spec_matches_dense_reference_rollout(model_and_params):
+    """And against the dense reference rollout (forecast_deltas) the
+    fused stream holds the same ULP band the dense spec stream is
+    pinned to."""
+    from beholder_tpu.models.decode import forecast_deltas
+
+    model, params = model_and_params
+    req = _request(4, horizon=9)
+    got = _batcher(
+        model, params, spec=SpecConfig(max_draft=3), fused_verify=True
+    ).run_spec([req])
+    want = np.asarray(
+        forecast_deltas(
+            model, params, jnp.asarray(req.progress)[None],
+            jnp.asarray(req.statuses)[None], req.horizon,
+        )[0],
+        np.float32,
+    )
+    np.testing.assert_allclose(got[0], want, rtol=1e-6, atol=1e-6)
+
+
+# -- the prefix-admission rewire ---------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "cache_dtype", [jnp.bfloat16, "int8"], ids=["bf16", "int8"]
+)
+def test_fused_prefix_admission_bitwise(model_and_params, cache_dtype):
+    """paged_admit_with_prefix(fused=True): the admit prediction AND
+    the scattered suffix pool bytes are bitwise the dense path's."""
+    model, params = model_and_params
+    state = _admitted_state(
+        model, params, slots=4, lens_tokens=2 * PAGE,
+        cache_dtype=cache_dtype,
+    )
+    cached_pages = state.page_table[0, :2]
+    suffix = jnp.asarray(
+        np.random.default_rng(5).normal(size=(1, PAGE, 7)).astype(np.float32)
+    )
+    outs = {}
+    for fused in (False, True):
+        pred, st = jax.jit(
+            lambda p, s, sf, f=fused: paged_admit_with_prefix(
+                model, p, s, jnp.int32(2), sf, jnp.int32(5),
+                cached_pages, fused=f,
+            )
+        )(params, state, suffix)
+        outs[fused] = (np.asarray(pred), st)
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])
+    from beholder_tpu.models.serving import slot_cache
+
+    for layer in range(model.layers):
+        dk, dv = slot_cache(outs[False][1], 2, layer)
+        fk, fv = slot_cache(outs[True][1], 2, layer)
+        np.testing.assert_array_equal(np.asarray(dk), np.asarray(fk))
+        np.testing.assert_array_equal(np.asarray(dv), np.asarray(fv))
+
+
+def test_fused_warm_cache_serving_bitwise(model_and_params):
+    """Through the engine: warm prefix-cache admissions with the fused
+    kernel on serve bit-identical streams to the dense path, cold and
+    warm."""
+    model, params = model_and_params
+    shared = np.cumsum(
+        1.0 + np.random.default_rng(7).normal(0, 0.05, 2 * PAGE + 1)
+    )
+
+    def mk(seed, horizon=6):
+        r = np.random.default_rng(60 + seed)
+        tail = shared[-1] + np.cumsum(1.0 + r.normal(0, 0.05, 3))
+        prog = np.concatenate([shared, tail])
+        return Request(prog, np.full(len(prog), STATUS), horizon)
+
+    streams = {}
+    for fused in (False, True):
+        b = _batcher(
+            model, params, num_pages=64,
+            prefix_cache=PrefixCache(PAGE), fused_verify=fused,
+        )
+        cold = b.run([mk(0)])
+        warm = b.run([mk(1)])
+        assert (b.prefix_cache.hits > 0) == True  # noqa: E712
+        streams[fused] = (cold[0], warm[0])
+    np.testing.assert_array_equal(streams[False][0], streams[True][0])
+    np.testing.assert_array_equal(streams[False][1], streams[True][1])
+
+
+# -- allocator / refcount stress with the fused kernel on --------------------
+
+
+def test_fused_full_eviction_refcount_stress(model_and_params):
+    """The spec suite's eviction/refcount stress with the fused kernel
+    ON: prefix-cache pages survive every round (the fused path never
+    writes a rejected token, so there is nothing to roll back INTO a
+    cached page), warm replays hit, full eviction returns the pool to
+    pristine."""
+    model, params = model_and_params
+    cache = PrefixCache(PAGE)
+    b = _batcher(
+        model, params, num_pages=64, prefix_cache=cache,
+        spec=SpecConfig(max_draft=3, drafter=LyingDrafter()),
+        fused_verify=True,
+    )
+    shared = np.cumsum(
+        1.0 + np.random.default_rng(3).normal(0, 0.05, 2 * PAGE + 1)
+    )
+
+    def mk(seed, horizon=8):
+        r = np.random.default_rng(50 + seed)
+        tail = shared[-1] + np.cumsum(1.0 + r.normal(0, 0.05, 4))
+        prog = np.concatenate([shared, tail])
+        return Request(prog, np.full(len(prog), STATUS), horizon)
+
+    reqs = [mk(i) for i in range(4)]
+    cold = b.run_spec(reqs)
+    assert cache.page_count > 0
+    ref = np.asarray(b.state.page_ref)
+    for page_id in cache.page_ids:
+        assert int(ref[page_id]) >= 1, f"cached page {page_id} was freed"
+    assert int(b.state.free_top) == b.num_pages - cache.page_count
+    warm = b.run_spec(reqs)
+    assert cache.hits > 0
+    for c, w in zip(cold, warm):
+        np.testing.assert_allclose(w, c, rtol=5e-2, atol=5e-2)
+    evicted = b._evict_cached(cache.page_count)
+    assert evicted > 0 and cache.page_count == 0
+    assert int(b.state.free_top) == b.num_pages
+    assert int(np.asarray(b.state.page_ref).sum()) == 0
+
+
+def test_fused_composes_with_fork_what_if(model_and_params):
+    """Interleave fused run_spec with the fork-based what-if path on
+    one batcher — refcounted fork pages and the fused commit must
+    coexist, and the pool must come home."""
+    model, params = model_and_params
+    b = _batcher(
+        model, params, spec=SpecConfig(max_draft=2), fused_verify=True
+    )
+    req = _request(11, horizon=6)
+    got = b.run_spec([req])
+    wi = b.run_what_if(
+        req.progress, req.statuses,
+        [STATUS, int(TelemetryStatusEntry.ERRORED)], horizon=5,
+    )
+    assert wi.shape == (2, 5)
+    got2 = b.run_spec([req])
+    np.testing.assert_array_equal(got2[0], got[0])
+    assert int(b.state.free_top) == b.num_pages
+
+
+def test_fused_commit_respects_fork_shared_pages(model_and_params):
+    """Direct allocator-level check: a fused commit for a slot whose
+    prefix pages are SHARED with a fork pops only fresh pages and
+    never touches the shared pages' refcounts."""
+    model, params = model_and_params
+    state = init_paged(model, 16, PAGE, 4, 8)
+    t = 2 * PAGE
+    feats = np.random.default_rng(0).normal(
+        size=(1, 2 * PAGE, 7)
+    ).astype(np.float32)
+    _, state = paged_admit_batch(
+        model, params, state,
+        jnp.asarray([0], jnp.int32), jnp.asarray(feats),
+        jnp.asarray([t], jnp.int32),
+    )
+    state = paged_fork(state, jnp.int32(0), jnp.asarray([1], jnp.int32))
+    shared = np.asarray(state.page_table)[0, :2]
+    free_before = int(state.free_top)
+    chunk = jnp.asarray(
+        np.random.default_rng(1).normal(size=(4, 3, 7)).astype(np.float32)
+    )
+    _, kvs = jax.jit(
+        lambda p, s, f: spec_verify_chunk(model, p, s, f)
+    )(params, state, chunk)
+    active = jnp.asarray([True, False, False, False])
+    state = jax.jit(spec_commit_step)(
+        state, kvs, jnp.asarray([3, 0, 0, 0], jnp.int32), active
+    )
+    ref = np.asarray(state.page_ref)
+    assert all(int(ref[p]) == 2 for p in shared)  # untouched
+    assert int(state.free_top) == free_before - 1  # one fresh page
+    assert int(state.seq_lens[0]) == t + 3
+    assert int(state.seq_lens[1]) == t  # fork untouched
+
+
+# -- capacity: the verify page budget ----------------------------------------
+
+
+def test_need_pages_drops_draft_transient(model_and_params):
+    model, params = model_and_params
+    spec = SpecConfig(max_draft=8)
+    dense = _batcher(model, params, spec=spec)
+    fused = _batcher(model, params, spec=spec, fused_verify=True)
+    req = _request(0, horizon=9)
+    assert fused._need_pages(req) < dense._need_pages(req)
+    # without spec the budgets agree (the transient was spec-only)
+    assert (
+        _batcher(model, params)._need_pages(req)
+        == _batcher(model, params, fused_verify=True)._need_pages(req)
+    )
+    assert fused._need_pages(req) == _batcher(
+        model, params
+    )._need_pages(req)
+
+
+def test_fused_capacity_admits_more_before_shed(model_and_params):
+    """The admitted-before-shed gain: under a page-budget intake, the
+    fused engine accepts strictly more of the same submission burst
+    than the dense engine (the max_draft transient is gone from every
+    request's cost)."""
+    model, params = model_and_params
+
+    def admitted(fused):
+        b = _batcher(
+            model, params,
+            spec=SpecConfig(max_draft=8),
+            fused_verify=fused,
+            max_pending=64,
+            max_pending_pages=24,
+        )
+        count = 0
+        for i in range(16):
+            if b.submit(_request(i, horizon=9)).accepted:
+                count += 1
+        return count
+
+    dense_n = admitted(False)
+    fused_n = admitted(True)
+    assert fused_n > dense_n, (fused_n, dense_n)
+
+
+# -- the no-dense-transient contract -----------------------------------------
+
+
+def _walk_jaxpr(jx, fn):
+    for eqn in jx.eqns:
+        for var in eqn.outvars:
+            fn(eqn, getattr(var.aval, "shape", ()))
+        for sub in eqn.params.values():
+            if hasattr(sub, "eqns"):
+                _walk_jaxpr(sub, fn)
+            elif hasattr(sub, "jaxpr"):
+                _walk_jaxpr(sub.jaxpr, fn)
+
+
+def test_fused_verify_never_materializes_dense_transient(model_and_params):
+    """The acceptance check: no operation in the fused verify program
+    may produce an all-slots full-span buffer (leading dim = slots
+    with a max_pages*page axis — the dense gather transient). The
+    dense program is the positive control: it MUST contain one, or
+    this check is vacuous."""
+    model, params = model_and_params
+    slots, max_pages = 4, 8
+    state = init_paged(model, 32, PAGE, slots, max_pages)
+    chunk = jnp.zeros((slots, 4, 7), jnp.float32)
+    span = max_pages * PAGE
+
+    def has_transient(make):
+        found = []
+
+        def check(eqn, shape):
+            if len(shape) >= 2 and shape[0] == slots and span in shape[1:]:
+                found.append(shape)
+
+        _walk_jaxpr(jax.make_jaxpr(make)(params, state, chunk).jaxpr, check)
+        return found
+
+    dense = has_transient(
+        lambda p, s, f: spec_verify_step(
+            model, p, s, f, jnp.ones((slots,), bool)
+        )
+    )
+    assert dense, "positive control: dense verify lost its gather?"
+
+    hkv = model.kv_heads or model.heads
+    zero_kv = jnp.zeros(
+        (slots, hkv, 4, model.dim // model.heads), jnp.bfloat16
+    )
+    prev = tuple((zero_kv, zero_kv) for _ in range(model.layers))
+    from beholder_tpu.spec.verify import spec_verify_commit
+
+    fused = has_transient(
+        lambda p, s, f: spec_verify_commit(
+            model, p, s, f, prev, jnp.zeros((slots,), jnp.int32)
+        )[0]
+    )
+    assert not fused, f"fused verify materialized {fused}"
+
+
+# -- knob-off + roofline family ----------------------------------------------
+
+
+def test_knob_defaults_off_and_dense_path_untouched(model_and_params):
+    model, params = model_and_params
+    b = _batcher(model, params, spec=SpecConfig(max_draft=3))
+    assert b.fused_verify is False
+    # the dense scheduler still dispatches spec_verify_step + rollback
+    # (the reference oracle is byte-identical with the knob absent)
+    reqs = [_request(0, horizon=6)]
+    got = b.run_spec(reqs)
+    ref = _batcher(model, params, spec=SpecConfig(max_draft=3)).run_spec(
+        reqs
+    )
+    np.testing.assert_array_equal(got[0], ref[0])
+
+
+def test_service_parses_serving_knobs():
+    from beholder_tpu.config import ConfigNode
+
+    cfg = ConfigNode({
+        "instance": {
+            "serving": {
+                "fused_verify": True,
+                "autotune": {"table": "/tmp/at.json"},
+            }
+        }
+    })
+    assert bool(cfg.get("instance.serving.fused_verify", False)) is True
+    assert cfg.get("instance.serving.autotune.table") == "/tmp/at.json"
+    assert (
+        ConfigNode({}).get("instance.serving.fused_verify", False) is False
+    )
+
+
+def test_fused_verify_round_tagged_paged_chunk_family(model_and_params):
+    """With the flight recorder armed, fused verify rounds carry the
+    'paged_chunk' kernel family (their own roofline series for the
+    perf gate), dense rounds keep 'verify'."""
+    from beholder_tpu.obs import FlightRecorder
+
+    model, params = model_and_params
+
+    def families(fused):
+        fr = FlightRecorder(ring_size=512)
+        b = _batcher(
+            model, params, spec=SpecConfig(max_draft=3),
+            fused_verify=fused, flight_recorder=fr,
+        )
+        b.run_spec([_request(0, horizon=6)])
+        return {
+            e["args"].get("family")
+            for e in fr.events()
+            if e.get("name") == "verify"
+        } - {None}
+
+    assert families(True) == {"paged_chunk"}
+    assert families(False) == {"verify"}
+
+
+# -- autotuner ---------------------------------------------------------------
+
+
+def test_autotune_table_roundtrip_and_resolution(tmp_path):
+    path = str(tmp_path / "table.json")
+    key = autotune.shape_key(
+        "paged_chunk", slots=4, width=4, max_pages=8, page=8,
+        kv_heads=2, head_dim=16, dtype="bfloat16",
+    )
+    entries = {
+        key: {
+            "config": {"slots_per_block": 2, "pages_per_block": 4},
+            "per_call_s": 1e-4,
+            "candidates": {"slots_per_block=2,pages_per_block=4": 1e-4},
+            "measured_unix_s": 0.0,
+        }
+    }
+    autotune.save_table(entries, path)
+    autotune.configure(path)
+    # deterministic: the same table yields the same config every time
+    # (identical kernel builds — the jit cache keys on it)
+    first = autotune.resolve_config(key)
+    assert first == {"slots_per_block": 2, "pages_per_block": 4}
+    assert autotune.resolve_config(key) == first
+    # cold miss -> defaults, not an error
+    assert autotune.resolve_config("paged_chunk/unknown") == (
+        autotune.DEFAULTS
+    )
+    # explicit config wins over the table
+    assert autotune.resolve_config(key, {"slots_per_block": 1}) == {
+        "slots_per_block": 1,
+        "pages_per_block": autotune.DEFAULTS["pages_per_block"],
+    }
+
+
+def test_autotune_missing_or_malformed_table_is_empty(tmp_path):
+    autotune.configure(str(tmp_path / "absent.json"))
+    assert autotune.resolve_config("anything") == autotune.DEFAULTS
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    autotune.configure(str(bad))
+    assert autotune.resolve_config("anything") == autotune.DEFAULTS
+
+
+def test_autotune_normalize_divisors_and_transient_cap():
+    # slots_per_block clamps to a divisor of slots, capped at slots//2
+    # (the no-dense-transient contract — even an explicit config may
+    # not rebuild the full-batch working set)
+    assert autotune.normalize({"slots_per_block": 8}, 8, 16) == (4, 2)
+    assert autotune.normalize({"slots_per_block": 3}, 8, 16)[0] == 2
+    assert autotune.normalize({"slots_per_block": 4}, 6, 16)[0] == 3
+    assert autotune.normalize({}, 1, 4) == (
+        1, min(autotune.DEFAULTS["pages_per_block"], 4)
+    )
+    # pages_per_block caps at the table width
+    assert autotune.normalize({"pages_per_block": 64}, 8, 4)[1] == 4
+    for cfg in autotune.candidate_configs(8, 16):
+        assert cfg["slots_per_block"] <= 4
+
+
+def test_autotune_search_picks_a_candidate():
+    calls = []
+
+    def build_fn(config):
+        def fn(prev):
+            calls.append(config["slots_per_block"])
+            # deterministic "timing": bigger blocks "faster"
+            import time as _t
+
+            _t.sleep(0.0005 / config["slots_per_block"])
+            return np.zeros(1)
+        return fn
+
+    candidates = [
+        {"slots_per_block": 1, "pages_per_block": 1},
+        {"slots_per_block": 4, "pages_per_block": 1},
+    ]
+    entry = autotune.autotune_entry(
+        "k", build_fn, candidates, k1=2, k2=4, rounds=1
+    )
+    assert entry["config"] in candidates
+    assert set(entry["candidates"]) == {
+        "pages_per_block=1,slots_per_block=1",
+        "pages_per_block=1,slots_per_block=4",
+    }
+    assert entry["per_call_s"] > 0
+
+
+def test_autotune_validate_table_errors():
+    with pytest.raises(ValueError, match="schema"):
+        autotune.validate_table({"schema": "nope", "entries": {}})
+    with pytest.raises(ValueError, match="entries"):
+        autotune.validate_table(
+            {"schema": autotune.SCHEMA, "schema_version": 1}
+        )
+    with pytest.raises(ValueError, match="config"):
+        autotune.validate_table({
+            "schema": autotune.SCHEMA, "schema_version": 1,
+            "entries": {"k": {"per_call_s": 1.0}},
+        })
+    with pytest.raises(ValueError, match="positive int"):
+        autotune.validate_table({
+            "schema": autotune.SCHEMA, "schema_version": 1,
+            "entries": {"k": {
+                "config": {"slots_per_block": 0}, "per_call_s": 1.0,
+            }},
+        })
+
+
+def test_committed_autotune_table_is_valid():
+    with open(autotune.DEFAULT_TABLE_PATH) as f:
+        table = json.load(f)
+    autotune.validate_table(table)
+    assert table["entries"], "committed table must carry entries"
+
+
+# -- artifact v9 + perf gate --------------------------------------------------
+
+
+def test_artifact_v9_kernel_block(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_kernel_test")
+    rec.record_kernel({
+        "fused_verify_ratio": 0.82,
+        "fused_verify_wall_s": 0.0023,
+        "dense_verify_wall_s": 0.0028,
+        "autotuned": {"k": {"slots_per_block": 4}},
+    })
+    path = rec.write(str(tmp_path / "a.json"))
+    loaded = artifact.validate_file(path)
+    assert loaded["schema_version"] >= 9
+    assert loaded["kernel"]["fused_verify_ratio"] == 0.82
+    # an empty kernel block is valid (a run that never timed the
+    # kernel), and a malformed summary is rejected at record time
+    rec2 = artifact.ArtifactRecorder("bench_other")
+    artifact.validate(rec2.to_dict())
+    with pytest.raises(ValueError, match="kernel summary"):
+        rec2.record_kernel({"fused_verify_ratio": 1.0})
+    # a v9 artifact with a broken kernel block fails validation
+    broken = rec2.to_dict()
+    broken["kernel"]["fused_verify_ratio"] = "fast"
+    with pytest.raises(ValueError, match="kernel.fused_verify_ratio"):
+        artifact.validate(broken)
+
+
+def _gate_artifact(ratio):
+    rec = artifact.ArtifactRecorder("g")
+    if ratio is not None:
+        rec.record_kernel({
+            "fused_verify_ratio": ratio,
+            "fused_verify_wall_s": 1.0,
+            "dense_verify_wall_s": 1.0 / ratio,
+            "autotuned": {},
+        })
+    return rec.to_dict()
+
+
+def test_perf_gate_bands_fused_verify_ratio():
+    base = _gate_artifact(0.8)
+    ok = run_gate(base, _gate_artifact(0.9))
+    assert "fused_verify_ratio" not in ok["failed"]
+    bad = run_gate(base, _gate_artifact(1.4))
+    assert "fused_verify_ratio" in bad["failed"]
+    # degradation is the ratio RISING; getting faster can't fail
+    faster = run_gate(base, _gate_artifact(0.5))
+    assert "fused_verify_ratio" not in faster["failed"]
+    # scenario absent on either side skips, never fails
+    skipped = run_gate(base, _gate_artifact(None))
+    assert "fused_verify_ratio" in [
+        s["metric"] for s in skipped["skipped"]
+    ]
+    reported = run_gate(base, _gate_artifact(0.9))["reported_not_gated"]
+    assert reported["kernel_fused_verify_wall_s"]["current"] == 1.0
+
+
+def test_committed_bench_kernel_artifact():
+    """The committed artifacts/bench_kernel.json is schema-valid, its
+    headline ratio shows fused <= dense on the recording host, and its
+    autotuned configs are non-empty — the acceptance evidence."""
+    loaded = artifact.validate_file("artifacts/bench_kernel.json")
+    assert loaded["schema_version"] >= 9
+    ratio = loaded["kernel"]["fused_verify_ratio"]
+    assert 0 < ratio <= 1.0, f"committed fused/dense ratio {ratio} > 1"
+    assert loaded["kernel"]["autotuned"]
