@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest perf-gate lint clean
 
 all: proto native
 
@@ -97,6 +97,17 @@ bench-slo:
 bench-kernel:
 	python bench.py --kernel-only
 
+# the batched-ingest scenario alone: the full consumer path over real
+# TCP sockets with the batched native front door ON vs the per-message
+# Python-framed path, INTERLEAVED (small-feed prefetch-4 + 4-connection
+# load scenarios), plus the per-poll frame-path cost table at 1/2/4-
+# frame feeds (writes artifacts/bench_ingest.json; the full `make
+# bench` run carries the same scenario inside bench_e2e.json's v10
+# ingest block). Builds the native scanner first — the batch entry
+# point is the thing being measured.
+bench-ingest: native
+	python bench.py --ingest-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -117,6 +128,8 @@ perf-gate:
 		--baseline artifacts/bench_slo.json --current artifacts/bench_slo.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_kernel.json --current artifacts/bench_kernel.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_ingest.json --current artifacts/bench_ingest.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -54,7 +54,12 @@ Schema (``validate`` is the authoritative checker)::
       "kernel": {"fused_verify_ratio": 0.0,
                  "fused_verify_wall_s": 0.0,
                  "dense_verify_wall_s": 0.0,
-                 "autotuned": {}}  # v9: fused paged-kernel evidence
+                 "autotuned": {}},  # v9: fused paged-kernel evidence
+      "ingest": {"wire_ingest_ratio": 0.0,
+                 "native_msgs_per_sec": 0.0,
+                 "python_msgs_per_sec": 0.0,
+                 "mean_batch_size": 0.0,
+                 "batched_msgs": 0.0}  # v10: batched native ingest
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -126,6 +131,15 @@ RISING), the two walls behind it (reported, never gated), and the
 block-size configs the autotuner picked (``autotuned`` — the same
 entries committed to ``artifacts/autotune_paged.json``). v1-v8
 artifacts remain valid.
+
+Schema v10 (the batched-ingest PR): the run's wire-ingest evidence
+rides along (:meth:`ArtifactRecorder.record_ingest`) —
+``wire_ingest_ratio`` (native-batched / python-framed wire throughput,
+both passes interleaved on the same host in the same session; the perf
+gate bands it, degradation = the ratio FALLING), the absolute msg/s on
+each side (reported, never gated — the BENCH_NOTES drift doctrine),
+and the batch-formation evidence (mean dispatched batch size, messages
+that rode a batch). v1-v9 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -137,7 +151,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -228,6 +242,16 @@ EMPTY_KERNEL = {
     "autotuned": {},
 }
 
+#: v10: the ingest block's required shape (an empty block is valid — a
+#: run that never drove the batched wire still writes a v10 artifact)
+EMPTY_INGEST = {
+    "wire_ingest_ratio": 0.0,
+    "native_msgs_per_sec": 0.0,
+    "python_msgs_per_sec": 0.0,
+    "mean_batch_size": 0.0,
+    "batched_msgs": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -310,6 +334,7 @@ class ArtifactRecorder:
         }
         self.slo: dict[str, Any] = copy.deepcopy(EMPTY_SLO)
         self.kernel: dict[str, Any] = copy.deepcopy(EMPTY_KERNEL)
+        self.ingest: dict[str, float] = dict(EMPTY_INGEST)
 
     def section(
         self,
@@ -476,6 +501,16 @@ class ArtifactRecorder:
             {key: summary[key] for key in EMPTY_KERNEL}
         )
 
+    def record_ingest(self, summary: dict[str, Any]) -> None:
+        """Adopt one batched-ingest bench summary as the run's v10
+        ``ingest`` block. Last writer wins — the block carries the
+        HEADLINE interleaved ratio (walls don't sum across scenarios);
+        per-scenario detail lives in the bench section + raw timings."""
+        for key in EMPTY_INGEST:
+            if key not in summary:
+                raise ValueError(f"ingest summary missing {key!r}")
+        self.ingest = {key: float(summary[key]) for key in EMPTY_INGEST}
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -522,6 +557,7 @@ class ArtifactRecorder:
             "failover": dict(self.failover),
             "slo": copy.deepcopy(self.slo),
             "kernel": copy.deepcopy(self.kernel),
+            "ingest": dict(self.ingest),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -580,6 +616,14 @@ def record_spec(registry) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_spec(registry)
+
+
+def record_ingest(summary: dict) -> None:
+    """Adopt a batched-ingest bench summary into the active recorder's
+    v10 ``ingest`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_ingest(summary)
 
 
 def record_attribution(summary: dict) -> None:
@@ -785,6 +829,18 @@ def validate(obj: Any) -> None:
                     "kernel.autotuned must be a dict, "
                     f"got {kernel.get('autotuned')!r}"
                 )
+    if isinstance(version, int) and version >= 10:
+        # v10: batched-ingest wire evidence is part of the evidence
+        ingest = obj.get("ingest")
+        if not isinstance(ingest, dict):
+            problems.append("ingest must be a dict (schema v10+)")
+        else:
+            for key in EMPTY_INGEST:
+                if not isinstance(ingest.get(key), (int, float)):
+                    problems.append(
+                        f"ingest.{key} must be a number, "
+                        f"got {ingest.get(key)!r}"
+                    )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):
         problems.append("raw_timings must be a list")

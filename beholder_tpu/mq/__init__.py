@@ -11,10 +11,23 @@ provides the same contract behind a small broker interface:
   and benchmarks, with real prefetch accounting.
 - :mod:`beholder_tpu.mq.amqp`   — an AMQP 0-9-1 wire-protocol client written
   from scratch (this image ships no AMQP client library).
+- :mod:`beholder_tpu.mq.ingest` — the batched native ingest path
+  (``instance.ingest.*``): one native scan per socket poll with
+  zero-copy payload views, whole-batch dispatch, and the lazily-
+  registered ``beholder_ingest_*`` catalog. Default OFF.
 """
 
 from .amqp import AmqpBroker
 from .base import Broker, Delivery
+from .ingest import BatchFeed, IngestConfig, ingest_from_config
 from .memory import InMemoryBroker
 
-__all__ = ["Broker", "Delivery", "InMemoryBroker", "AmqpBroker"]
+__all__ = [
+    "Broker",
+    "Delivery",
+    "InMemoryBroker",
+    "AmqpBroker",
+    "BatchFeed",
+    "IngestConfig",
+    "ingest_from_config",
+]

@@ -115,6 +115,11 @@ def ext_available() -> bool:
     return _ext is not None
 
 
+def lib_available() -> bool:
+    """Is the ctypes-loaded ``libframecodec.so`` scanner present?"""
+    return _lib is not None
+
+
 def ext_scan(buf: bytearray, factory) -> tuple[list, int]:
     """One C pass: scan + payload slicing + tuple building all inside
     the extension; Python only wraps the (type, channel, payload)
@@ -143,6 +148,50 @@ class NativeScanner:
         self._offsets_mv = memoryview(self._offsets).cast("B").cast("q")
         self._sizes_mv = memoryview(self._sizes).cast("B").cast("q")
 
+    def _scan_loop(
+        self, ptr_at, total: int, mv: memoryview, factory, detach: bool
+    ) -> tuple[list, int]:
+        """The one scan loop both entry points share (the ctypes twin of
+        the C-API module's ``scan_core``): ``ptr_at(offset)`` abstracts
+        the buffer export (mutable ``from_buffer`` vs immutable base
+        address) and ``detach`` the payload materialization (bytes copy
+        vs zero-copy view) — the only two ways :meth:`scan` and
+        :meth:`scan_views` differ, so the walk itself cannot drift."""
+        frames: list = []
+        consumed_total = 0
+        while True:
+            n = _lib.amqp_scan_frames(
+                ptr_at(consumed_total),
+                total - consumed_total,
+                self._types,
+                self._channels,
+                self._offsets,
+                self._sizes,
+                _MAX_FRAMES,
+                ctypes.byref(self._consumed),
+            )
+            if n < 0:
+                pos = consumed_total + self._consumed.value
+                err = ValueError(f"bad frame end at buffer offset {pos}")
+                err.offset = pos
+                raise err
+            # bulk-convert the scratch arrays via the buffer protocol:
+            # per-element ctypes __getitem__ costs ~100ns each and made
+            # the native path slower than the pure-Python walk; one
+            # memoryview.tolist() per array is a single C-speed pass
+            types = self._types_mv[:n].tolist()
+            channels = self._channels_mv[:n].tolist()
+            offsets = self._offsets_mv[:n].tolist()
+            sizes = self._sizes_mv[:n].tolist()
+            append = frames.append
+            for t, c, off, size in zip(types, channels, offsets, sizes):
+                start = consumed_total + off
+                payload = mv[start : start + size]
+                append(factory(t, c, bytes(payload) if detach else payload))
+            consumed_total += self._consumed.value
+            if n < _MAX_FRAMES:
+                return frames, consumed_total
+
     def scan(self, buf: bytearray, factory) -> tuple[list, int]:
         """Scan ``buf`` for complete frames without copying it.
 
@@ -152,50 +201,46 @@ class NativeScanner:
         passes its ``Frame`` class so no intermediate tuples are built).
         Raises ``ValueError`` on a bad frame-end octet.
         """
-        frames: list = []
         total = len(buf)
         if total < 8:
-            return frames, 0
+            return [], 0
         cbuf = (ctypes.c_char * total).from_buffer(buf)
         mv = memoryview(buf)
-        consumed_total = 0
         try:
-            while True:
-                ptr = ctypes.cast(
-                    ctypes.byref(cbuf, consumed_total),
+
+            def ptr_at(offset):
+                return ctypes.cast(
+                    ctypes.byref(cbuf, offset),
                     ctypes.POINTER(ctypes.c_char),
                 )
-                n = _lib.amqp_scan_frames(
-                    ptr,
-                    total - consumed_total,
-                    self._types,
-                    self._channels,
-                    self._offsets,
-                    self._sizes,
-                    _MAX_FRAMES,
-                    ctypes.byref(self._consumed),
-                )
-                if n < 0:
-                    raise ValueError(
-                        "bad frame end at buffer offset "
-                        f"{consumed_total + self._consumed.value}"
-                    )
-                # bulk-convert the scratch arrays via the buffer protocol:
-                # per-element ctypes __getitem__ costs ~100ns each and made
-                # the native path slower than the pure-Python walk; one
-                # memoryview.tolist() per array is a single C-speed pass
-                types = self._types_mv[:n].tolist()
-                channels = self._channels_mv[:n].tolist()
-                offsets = self._offsets_mv[:n].tolist()
-                sizes = self._sizes_mv[:n].tolist()
-                append = frames.append
-                for t, c, off, size in zip(types, channels, offsets, sizes):
-                    start = consumed_total + off
-                    append(factory(t, c, bytes(mv[start : start + size])))
-                consumed_total += self._consumed.value
-                if n < _MAX_FRAMES:
-                    return frames, consumed_total
+
+            return self._scan_loop(ptr_at, total, mv, factory, detach=True)
         finally:
             # release buffer exports so the caller may resize ``buf``
             mv.release()
             del cbuf
+
+    def scan_views(self, buf: bytes, factory) -> tuple[list, int]:
+        """Batched-ingest variant of :meth:`scan`: ``buf`` is an
+        IMMUTABLE bytes generation owned by the batch feed, and each
+        payload is a zero-copy memoryview into it (the view refcounts
+        the generation — same lifetime contract as the C-API
+        extension's ``scan_views``). Raises ``ValueError`` on a bad
+        frame-end octet with the shared message format."""
+        total = len(buf)
+        if total < 8:
+            return [], 0
+        # bytes is read-only, so from_buffer is off the table; a
+        # c_char_p cast yields the base address (buf stays referenced
+        # for the duration of this call, so the pointer stays valid)
+        base = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+
+        def ptr_at(offset):
+            return ctypes.cast(
+                ctypes.c_void_p(base + offset),
+                ctypes.POINTER(ctypes.c_char),
+            )
+
+        return self._scan_loop(
+            ptr_at, total, memoryview(buf), factory, detach=False
+        )

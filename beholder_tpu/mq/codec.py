@@ -344,6 +344,22 @@ def parse_method(frame: Frame) -> tuple[tuple[int, int], Reader]:
     return (cid, mid), reader
 
 
+def bad_frame_offset(err: ValueError) -> int | None:
+    """The bad frame's start offset from a scanner's ValueError — the
+    ONE place that knows how backends report it. The Python-side
+    scanners attach it structurally (``err.offset``); the C-API
+    extension reports it only in its documented message format
+    ("... at buffer offset N", pinned identical across backends by
+    tests/test_ingest.py), which the regex fallback covers."""
+    offset = getattr(err, "offset", None)
+    if offset is not None:
+        return int(offset)
+    import re
+
+    m = re.search(r"offset (\d+)$", str(err))
+    return int(m.group(1)) if m else None
+
+
 class FrameParser:
     """Incremental byte-stream -> frame parser.
 
@@ -406,16 +422,13 @@ class FrameParser:
         scanners raise WITHOUT consuming the good frames before the bad
         one (they stay in the buffer, so a retry would re-raise at the
         same point), while the pure-Python walk consumes as it goes.
-        Both native layers report the bad frame's start offset in their
-        documented message format — trim up to it so all three backends
-        leave the buffer starting AT the bad frame, exactly like the
-        Python walk (round-4 advisor finding)."""
-        import re
-
+        Both native layers report the bad frame's start offset — trim up
+        to it so all three backends leave the buffer starting AT the bad
+        frame, exactly like the Python walk (round-4 advisor finding)."""
         msg = str(err)
-        m = re.search(r"offset (\d+)$", msg)
-        if m:
-            del self._buf[: int(m.group(1))]
+        offset = bad_frame_offset(err)
+        if offset is not None:
+            del self._buf[:offset]
             # the reported offset described the PRE-trim buffer; the
             # retained buffer now starts at the bad frame
             msg += " (buffer trimmed; the bad frame is now at offset 0)"

@@ -116,6 +116,12 @@ class _Conn(asyncio.Protocol):
         self.next_tag = 1
         # in-flight publish: [routing_key, expected_size, chunks, headers]
         self._pending: list | None = None
+        #: pump-once-per-recv: frame handlers that used to pump per ack/
+        #: publish set this instead, and data_received pumps ONCE after
+        #: the whole poll — a 50-publish poll schedules one delivery
+        #: sweep, not 50 (the per-message pump was the broker-side hot
+        #: loop's syscall amplifier)
+        self._pump_soon = False
         self._hb_task: asyncio.Task | None = None
         self._log = server._log
 
@@ -155,6 +161,9 @@ class _Conn(asyncio.Protocol):
             self._send_start()
         for frame in self.parser.feed(data):
             self._on_frame(frame)
+        if self._pump_soon:
+            self._pump_soon = False
+            self.server.pump()
 
     # -- helpers ------------------------------------------------------------
     def _send(self, frame: codec.Frame) -> None:
@@ -263,7 +272,7 @@ class _Conn(asyncio.Protocol):
             self._send_method(
                 frame.channel, codec.BASIC_CONSUME_OK, codec.Writer().shortstr(tag).getvalue()
             )
-            self.server.pump()
+            self._pump_soon = True
         elif cm == codec.BASIC_PUBLISH:
             reader.short()
             reader.shortstr()  # exchange ("" = default)
@@ -277,7 +286,7 @@ class _Conn(asyncio.Protocol):
             )
             for t in tags:
                 self.unacked.pop(t, None)
-            self.server.pump()
+            self._pump_soon = True
         elif cm == codec.BASIC_NACK:
             tag = reader.longlong()
             flags = reader.octet()
@@ -293,7 +302,7 @@ class _Conn(asyncio.Protocol):
                 # (RabbitMQ x-dead-letter-exchange), else drop
                 queue, body, headers, _enq = entry
                 self.server.dead_letter_route(queue, body, headers, "rejected")
-            self.server.pump()
+            self._pump_soon = True
         elif cm == codec.CONNECTION_CLOSE:
             self._send_method(0, codec.CONNECTION_CLOSE_OK)
             self.transport.close()
@@ -318,7 +327,7 @@ class _Conn(asyncio.Protocol):
         self.server.queues.setdefault(pending[0], deque()).append(
             (body, False, pending[3], time.monotonic())
         )
-        self.server.pump()
+        self._pump_soon = True
 
     # -- delivery -----------------------------------------------------------
     def can_take(self) -> bool:
@@ -331,6 +340,8 @@ class _Conn(asyncio.Protocol):
         redelivered: bool,
         headers: dict,
         enqueued_at: float | None = None,
+        *,
+        out: bytearray,
     ) -> None:
         tag = self.next_tag
         self.next_tag += 1
@@ -347,20 +358,15 @@ class _Conn(asyncio.Protocol):
             .shortstr(queue)  # routing key
             .getvalue()
         )
-        # one write per delivery: method + header + body frames coalesced
-        # (3+ separate transport.write calls each cost a send syscall on an
-        # idle connection; this path is the broker's hot loop)
-        out = bytearray(codec.method_frame(1, codec.BASIC_DELIVER, args).serialize())
+        # frames coalesce into pump()'s per-connection buffer: one send
+        # syscall per pump sweep, not per delivery — this path is the
+        # broker's hot loop
+        out += codec.method_frame(1, codec.BASIC_DELIVER, args).serialize()
         out += codec.header_frame(
             1, codec.CLASS_BASIC, len(body), headers=headers
         ).serialize()
         for bf in codec.body_frames(1, body, codec_frame_max()):
             out += bf.serialize()
-        if self.transport and not self.transport.is_closing():
-            # write the bytearray directly: the transport copies into its own
-            # buffer, and a bytes(out) round trip would re-copy the whole
-            # body on this hot loop
-            self.transport.write(out)
 
 
 def codec_frame_max() -> int:
@@ -532,9 +538,14 @@ class AmqpTestServer:
     # -- scheduling ---------------------------------------------------------
     def pump(self) -> None:
         """Deliver queued messages to consumers with free prefetch slots
-        (after expiring TTL-overdue heads into their DLQs)."""
+        (after expiring TTL-overdue heads into their DLQs). Each sweep
+        coalesces one connection's deliveries into ONE socket write —
+        a 30-message drain used to cost 30 send syscalls and wake the
+        consumer 30 times; now it is one segment the consumer's batched
+        ingest path scans in one native pass."""
         if self._message_ttl:
             self._expire(time.monotonic())
+        writes: dict[_Conn, bytearray] = {}
         for queue, pending in list(self.queues.items()):
             consumers = [
                 c for c in self.consumers.get(queue, []) if c.can_take()
@@ -543,11 +554,19 @@ class AmqpTestServer:
                 body, redelivered, headers, *rest = pending.popleft()
                 idx = self._rr.get(queue, 0) % len(consumers)
                 self._rr[queue] = idx + 1
-                consumers[idx].deliver(
+                conn = consumers[idx]
+                out = writes.get(conn)
+                if out is None:
+                    out = writes[conn] = bytearray()
+                conn.deliver(
                     queue, body, redelivered, headers,
                     enqueued_at=rest[0] if rest else None,
+                    out=out,
                 )
                 consumers = [c for c in consumers if c.can_take()]
+        for conn, out in writes.items():
+            if conn.transport and not conn.transport.is_closing():
+                conn.transport.write(out)
         # pump() runs after every queue mutation (publish, ack, nack,
         # consume, connection loss), so refreshing the gauges here keeps
         # them current without a second bookkeeping path

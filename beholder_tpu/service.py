@@ -46,7 +46,8 @@ from beholder_tpu.config import Config, ConfigNode, dyn, no_trello
 from beholder_tpu.log import get_logger
 from beholder_tpu.metrics import Metrics
 from beholder_tpu.mq import Broker, Delivery
-from beholder_tpu.storage import SqliteStorage, Storage
+from beholder_tpu.mq.ingest import ingest_from_config
+from beholder_tpu.storage import MediaNotFound, SqliteStorage, Storage
 
 STATUS_TOPIC = "v1.telemetry.status"
 PROGRESS_TOPIC = "v1.telemetry.progress"
@@ -268,6 +269,19 @@ class BeholderService:
             # instants stream into the tracker as they are recorded
             self.flight_recorder.add_listener(self.slo.on_event)
 
+        #: optional batched native ingest (``instance.ingest.*``; OFF
+        #: by default ⇒ the per-message wire path, handler outcomes and
+        #: the default exposition stay byte-identical). Enabled, a
+        #: supporting broker (AmqpBroker) scans each socket poll in ONE
+        #: native pass with zero-copy payload views, dispatches whole
+        #: drained batches, and the consumers register batch PREPARE
+        #: stages that fold per-message work: one protobuf decode pass
+        #: and ONE storage transaction per drained batch
+        #: (``update_status_batch``), while the per-message handler
+        #: chain — tracing, timing, at-least-once settlement — runs
+        #: unchanged. Parsing is import-light like the other knobs.
+        self.ingest = ingest_from_config(config)
+
         #: optional cluster serving (``instance.cluster.*``; OFF by
         #: default). A library knob like ``spec``: the service parses
         #: it once into a :class:`beholder_tpu.cluster.ClusterConfig`
@@ -358,6 +372,18 @@ class BeholderService:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Register both consumers (index.js:62,127) and log 'initialized'."""
+        if self.ingest is not None:
+            # arm the broker's batched ingest path BEFORE connect (the
+            # per-connection batch feed is built at handshake time);
+            # brokers without the surface (InMemoryBroker) stay on the
+            # per-message path with identical semantics
+            configure = getattr(self.broker, "configure_ingest", None)
+            if configure is not None:
+                configure(
+                    self.ingest,
+                    registry=self.metrics.registry,
+                    flight_recorder=self.flight_recorder,
+                )
         self.broker.connect()
         status, progress = self.handle_status, self.handle_progress
         if self.handle_seconds is not None:
@@ -395,8 +421,16 @@ class BeholderService:
                 STATUS_TOPIC: status,
                 PROGRESS_TOPIC: progress,
             }
-        self.broker.listen(STATUS_TOPIC, status)
-        self.broker.listen(PROGRESS_TOPIC, progress)
+        if self.ingest is not None:
+            self.broker.listen_batch(
+                STATUS_TOPIC, status, self.prepare_status_batch
+            )
+            self.broker.listen_batch(
+                PROGRESS_TOPIC, progress, self.prepare_progress_batch
+            )
+        else:
+            self.broker.listen(STATUS_TOPIC, status)
+            self.broker.listen(PROGRESS_TOPIC, progress)
         self.logger.info("initialized")
 
     def _timed(self, topic: str, handler):
@@ -498,17 +532,138 @@ class BeholderService:
         self.trello.comment_card(card_id, text)
         self.metrics.trello_comments_total.inc()
 
+    # -- batched ingest prepare stages -------------------------------------
+    def prepare_status_batch(self, deliveries: list[Delivery]) -> None:
+        """Batched-ingest prepare for ``v1.telemetry.status``: one
+        protobuf decode pass and ONE storage transaction for the whole
+        drained run (``update_status_batch``), stashing per-delivery
+        results on ``delivery.prepared`` for :meth:`handle_status` —
+        which still runs per message under its usual wrappers, so acks,
+        redelivery, tracing and error outcomes are unchanged.
+
+        In at-least-once mode the fold STOPS at the first redelivered
+        message: the ReliableConsumer's dedup window may skip its
+        handler entirely (the prepare must not run side effects the
+        handler won't), and folding LATER same-media writes into a
+        transaction that commits BEFORE the redelivered message's own
+        inline write would invert the per-message loop's arrival-order
+        outcome — so everything from the redelivered message on falls
+        back to the per-message path, in order.
+        A message whose decode fails is left without a ``msg`` (the
+        handler re-decodes and raises in its OWN scope, exactly like
+        the per-message loop); a wholesale write failure leaves the
+        ``found`` flags off and every handler re-runs its update inline."""
+        rows: dict[str, proto.Media] = {}
+        pending: list[tuple[dict, str, int]] = []
+        for delivery in deliveries:
+            if self._at_least_once and delivery.redelivered:
+                break
+            prepared: dict = {"rows": rows}
+            delivery.prepared = prepared
+            try:
+                msg = proto.decode(self._status_proto, delivery.body)
+            except Exception:  # noqa: BLE001 - re-raised by the handler
+                continue
+            prepared["msg"] = msg
+            pending.append((prepared, msg.mediaId, msg.status))
+        if not pending or not self.ingest.batch_storage:
+            return
+        try:
+            found = self.db.update_status_batch(
+                [(media_id, status) for _, media_id, status in pending]
+            )
+        except Exception as err:  # noqa: BLE001 - degrade to inline writes
+            self.logger.warning(
+                f"batched status write failed ({err!r}); "
+                "falling back to per-message updates"
+            )
+            return
+        for (prepared, _, _), ok in zip(pending, found):
+            prepared["found"] = ok
+        # prefetch the post-write rows in ONE query (the handlers'
+        # read-after-own-write; _read_media overrides status per
+        # message). Best-effort: a miss here just re-reads inline.
+        # NO_TRELLO handlers ack right after the write and never read —
+        # match the per-message loop's zero reads in that mode.
+        if no_trello():
+            return
+        try:
+            rows.update(
+                self.db.get_by_ids(
+                    [p[1] for p, ok in zip(pending, found) if ok]
+                )
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def prepare_progress_batch(self, deliveries: list[Delivery]) -> None:
+        """Batched-ingest prepare for ``v1.telemetry.progress``: one
+        decode pass plus a shared per-run row-read memo (the progress
+        handler only reads media rows — one ``get_by_id`` per distinct
+        id per run instead of per message)."""
+        rows: dict[str, proto.Media] = {}
+        media_ids: list[str] = []
+        for delivery in deliveries:
+            if self._at_least_once and delivery.redelivered:
+                continue
+            prepared: dict = {"rows": rows}
+            delivery.prepared = prepared
+            try:
+                msg = proto.decode(self._progress_proto, delivery.body)
+            except Exception:  # noqa: BLE001 - re-raised by the handler
+                continue
+            prepared["msg"] = msg
+            media_ids.append(msg.mediaId)
+        # one read round trip for the whole run; a missing id keeps its
+        # MediaNotFound outcome (the handler's fallback read raises)
+        if media_ids:
+            try:
+                rows.update(self.db.get_by_ids(media_ids))
+            except Exception:  # noqa: BLE001 - handlers re-read inline
+                pass
+
+    def _read_media(
+        self, prepared: dict | None, media_id: str, status: int | None = None
+    ) -> proto.Media:
+        """Row read, batch-aware: on the per-message path it is exactly
+        ``db.get_by_id``; on the batched path the run's shared memo
+        serves one read per distinct id (the per-message loop re-reads
+        the same row identically on this same thread). ``status``
+        overrides the returned row's status with THIS message's own
+        just-written value — which is precisely what the per-message
+        read-after-own-write observes, including when a later message
+        in the batch already moved the row on."""
+        if prepared is None:
+            return self.db.get_by_id(media_id)
+        rows = prepared["rows"]
+        media = rows.get(media_id)
+        if media is None:
+            media = rows[media_id] = self.db.get_by_id(media_id)
+        clone = proto.Media()
+        clone.CopyFrom(media)
+        if status is not None:
+            clone.status = status
+        return clone
+
     # -- consumers ---------------------------------------------------------
     def handle_status(self, delivery: Delivery) -> None:
         """v1.telemetry.status (index.js:62-125)."""
-        msg = proto.decode(self._status_proto, delivery.body)
+        prepared = delivery.prepared
+        if prepared is not None and "msg" in prepared:
+            msg = prepared["msg"]
+        else:
+            msg = proto.decode(self._status_proto, delivery.body)
         media_id, status = msg.mediaId, msg.status
 
         self.logger.info(
             "processing status update for media %s, status: %s", media_id, status
         )
 
-        self.db.update_status(media_id, status)
+        found = prepared.get("found") if prepared is not None else None
+        if found is None:
+            self.db.update_status(media_id, status)
+        elif not found:
+            raise MediaNotFound(media_id)
 
         if no_trello():
             return delivery.ack()  # index.js:70-72
@@ -518,7 +673,7 @@ class BeholderService:
             status_text = self._status_names[status] = proto.enum_to_string(
                 self._status_proto, "TelemetryStatusEntry", status
             )
-        media = self.db.get_by_id(media_id)
+        media = self._read_media(prepared, media_id, status)
 
         # Trello card movement (index.js:79-90)
         if media.creator == 1:
@@ -558,7 +713,11 @@ class BeholderService:
     def handle_progress(self, delivery: Delivery) -> None:
         """v1.telemetry.progress (index.js:127-155)."""
         try:
-            msg = proto.decode(self._progress_proto, delivery.body)
+            prepared = delivery.prepared
+            if prepared is not None and "msg" in prepared:
+                msg = prepared["msg"]
+            else:
+                msg = proto.decode(self._progress_proto, delivery.body)
             media_id, status = msg.mediaId, msg.status
             progress, host = msg.progress, msg.host
 
@@ -594,7 +753,7 @@ class BeholderService:
                     )
                     self.analytics = None
 
-            media = self.db.get_by_id(media_id)
+            media = self._read_media(prepared, media_id)
 
             if media.creator == self._creator_trello:
                 comment_text = f"{status_text}: Progress **{progress}%**"

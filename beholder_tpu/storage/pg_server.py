@@ -322,6 +322,13 @@ class PgTestServer:
         try:
             if flat.upper().startswith("CREATE TABLE"):
                 return _msg(b"C", _cstr("CREATE TABLE")), "create"
+            # transaction statements: the batched-ingest storage hop
+            # wraps a drained batch's updates in BEGIN/COMMIT (one
+            # commit per batch); the in-memory engine applies rows
+            # eagerly, so the control statements just tag-acknowledge
+            # (the semantics the idempotent UPDATE replay relies on)
+            if flat.upper() in ("BEGIN", "COMMIT", "ROLLBACK"):
+                return _msg(b"C", _cstr(flat.upper())), "txn"
             if flat.startswith("INSERT INTO media"):
                 row = dict(zip(self.COLUMNS, params))
                 self.rows[row["id"]] = row

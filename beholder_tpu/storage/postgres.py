@@ -101,6 +101,40 @@ class PostgresStorage(Storage):
         if tag.endswith(" 0"):  # "UPDATE 0" — no row matched
             raise MediaNotFound(media_id)
 
+    def update_status_batch(
+        self, updates: list[tuple[str, int]]
+    ) -> list[bool]:
+        """One BEGIN/COMMIT per drained ingest batch instead of one
+        autocommit per message. Statements run in order inside the
+        transaction; per-row "UPDATE 0" tags become found flags (the
+        MediaNotFound outcomes the per-message loop produces). The
+        whole batch shares one :meth:`_run` retry scope — absolute
+        status updates are idempotent, so a reconnect replays the batch
+        safely."""
+
+        def run() -> list[bool]:
+            self._conn.execute("BEGIN")
+            try:
+                found: list[bool] = []
+                for media_id, status in updates:
+                    _, _, tag = self._conn.query(
+                        "UPDATE media SET status = $1 WHERE id = $2",
+                        (int(status), media_id),
+                    )
+                    found.append(not tag.endswith(" 0"))
+            except BaseException:
+                # roll back best-effort; a poisoned connection is
+                # handled (and the batch replayed) by _run's reconnect
+                try:
+                    self._conn.execute("ROLLBACK")
+                except ProtocolError:
+                    pass
+                raise
+            self._conn.execute("COMMIT")
+            return found
+
+        return self._run(run)
+
     def get_by_id(self, media_id: str) -> proto.Media:
         _, rows, _ = self._run(
             lambda: self._conn.query(

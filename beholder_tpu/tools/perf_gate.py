@@ -31,6 +31,9 @@ metric                      why it survives host drift                fails
                             verify-round wall, slope-timed
                             interleaved in the same session — host
                             speed divides out
+``wire_ingest_ratio``       native-batched / python-framed wire       lower
+                            throughput, interleaved passes in the
+                            same session — host speed divides out
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -112,6 +115,14 @@ NOISE_BANDS: dict[str, float] = {
     # rising back toward/past the dense oracle), not scheduler jitter
     # around the committed value
     "fused_verify_ratio": 0.40,
+    # native-batched / python-framed wire throughput (schema v10): both
+    # sides interleaved over the same sockets on the same host, so host
+    # drift divides out — what the band must catch is the batched front
+    # door losing its edge (the ratio falling back toward the
+    # per-message loop), not scheduler jitter. Thread-scheduling
+    # weather moves this more than the kernel ratios (four live threads
+    # per pass), hence the kernel-width band
+    "wire_ingest_ratio": 0.40,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -202,6 +213,13 @@ def _slo_attainment(artifact: dict) -> float | None:
     return float(value)
 
 
+def _wire_ingest_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "ingest", "wire_ingest_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v10 artifact / ingest scenario not run
+    return float(value)
+
+
 def _fused_verify_ratio(artifact: dict) -> float | None:
     value = _get(artifact, "kernel", "fused_verify_ratio")
     if not isinstance(value, (int, float)) or value <= 0:
@@ -231,6 +249,9 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # fused/dense verify wall: a fused-kernel regression shows as the
     # ratio RISING back toward the dense-gather cost
     ("fused_verify_ratio", _fused_verify_ratio, "higher"),
+    # native-batched/python-framed wire throughput: an ingest-path
+    # regression shows as the ratio FALLING toward the per-message loop
+    ("wire_ingest_ratio", _wire_ingest_ratio, "lower"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -276,6 +297,20 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "kernel_dense_verify_wall_s",
         lambda a: _get(a, "kernel", "dense_verify_wall_s"),
+    ),
+    # absolute wire throughput behind wire_ingest_ratio: host-speed-
+    # dependent (a 14x cross-host swing is on record), reported only
+    (
+        "wire_msgs_per_sec",
+        lambda a: _get(a, "sections", "wire_native", "result", "rate"),
+    ),
+    (
+        "ingest_native_msgs_per_sec",
+        lambda a: _get(a, "ingest", "native_msgs_per_sec"),
+    ),
+    (
+        "ingest_python_msgs_per_sec",
+        lambda a: _get(a, "ingest", "python_msgs_per_sec"),
     ),
 ]
 

@@ -23,22 +23,23 @@
 namespace {
 constexpr uint8_t kFrameEnd = 0xCE;
 constexpr Py_ssize_t kHeaderSize = 7;  // type(1) + channel(2) + size(4)
-}  // namespace
 
-// scan(buffer) -> (list[(type, channel, payload: bytes)], consumed)
-// Raises ValueError on a bad frame-end octet, reporting the bad frame's
-// start offset (the caller keeps everything before it consumed).
-static PyObject* scan(PyObject* self, PyObject* arg) {
-  Py_buffer view;
-  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
-    return nullptr;
-  }
-  const uint8_t* buf = static_cast<const uint8_t*>(view.buf);
-  const Py_ssize_t len = view.len;
+// How a scanned payload is materialized: bytes copy (scan) or a
+// zero-copy sub-view of the caller's buffer (scan_views). Everything
+// else about the walk — header decode, bounds, the kFrameEnd check and
+// its error offset — is shared, so the two entry points (and the ctypes
+// backend layered on framecodec.cc's identical loop) cannot drift.
+typedef PyObject* (*PayloadFn)(void* ctx, const uint8_t* buf,
+                               Py_ssize_t off, Py_ssize_t size);
 
+// Shared frame walk over buf[0..len): returns a (frames, consumed)
+// tuple, or nullptr with a Python error set (bad frame end reports the
+// bad frame's start offset; the caller keeps everything before it
+// consumed).
+PyObject* scan_core(const uint8_t* buf, Py_ssize_t len,
+                    PayloadFn make_payload, void* ctx) {
   PyObject* frames = PyList_New(0);
   if (frames == nullptr) {
-    PyBuffer_Release(&view);
     return nullptr;
   }
 
@@ -54,37 +55,85 @@ static PyObject* scan(PyObject* self, PyObject* arg) {
     if (len - pos < total) break;
     if (buf[pos + kHeaderSize + size] != kFrameEnd) {
       Py_DECREF(frames);
-      PyBuffer_Release(&view);
       PyErr_Format(PyExc_ValueError, "bad frame end at buffer offset %zd",
                    pos);
       return nullptr;
     }
-    PyObject* payload = PyBytes_FromStringAndSize(
-        reinterpret_cast<const char*>(buf + pos + kHeaderSize),
-        (Py_ssize_t)size);
+    PyObject* payload =
+        make_payload(ctx, buf, pos + kHeaderSize, (Py_ssize_t)size);
     if (payload == nullptr) {
       Py_DECREF(frames);
-      PyBuffer_Release(&view);
       return nullptr;
     }
     PyObject* tup = Py_BuildValue("(IIN)", type, channel, payload);
     if (tup == nullptr || PyList_Append(frames, tup) != 0) {
       Py_XDECREF(tup);
       Py_DECREF(frames);
-      PyBuffer_Release(&view);
       return nullptr;
     }
     Py_DECREF(tup);
     pos += total;
   }
 
-  PyBuffer_Release(&view);
   return Py_BuildValue("(Nn)", frames, pos);
+}
+
+PyObject* payload_bytes(void* ctx, const uint8_t* buf, Py_ssize_t off,
+                        Py_ssize_t size) {
+  (void)ctx;
+  return PyBytes_FromStringAndSize(reinterpret_cast<const char*>(buf + off),
+                                   size);
+}
+
+// zero-copy payload: a sub-view of the master memoryview (the slice
+// holds a reference chain master -> caller's buffer, so lifetime is
+// refcounted, not borrowed)
+PyObject* payload_view(void* ctx, const uint8_t* buf, Py_ssize_t off,
+                       Py_ssize_t size) {
+  (void)buf;
+  return PySequence_GetSlice(static_cast<PyObject*>(ctx), off, off + size);
+}
+}  // namespace
+
+// scan_views(buffer) -> (list[(type, channel, payload: memoryview)], consumed)
+//
+// The batched ingest entry point: ONE C call per socket poll that scans
+// every complete frame in the recv buffer and slices each payload as a
+// ZERO-COPY memoryview over the caller's buffer (no per-frame bytes
+// allocation — the scan() path below copies every payload). Each view
+// keeps the underlying buffer alive by refcount, so the caller hands the
+// whole batch downstream and lets the buffer generation die when the
+// last view does (beholder_tpu/mq/ingest.py owns the generation
+// discipline: one fresh buffer per poll, never resized while exported).
+static PyObject* scan_views(PyObject* self, PyObject* arg) {
+  PyObject* master = PyMemoryView_FromObject(arg);
+  if (master == nullptr) {
+    return nullptr;
+  }
+  const Py_buffer* vb = PyMemoryView_GET_BUFFER(master);
+  PyObject* result = scan_core(static_cast<const uint8_t*>(vb->buf), vb->len,
+                               payload_view, master);
+  Py_DECREF(master);
+  return result;
+}
+
+// scan(buffer) -> (list[(type, channel, payload: bytes)], consumed)
+static PyObject* scan(PyObject* self, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) != 0) {
+    return nullptr;
+  }
+  PyObject* result = scan_core(static_cast<const uint8_t*>(view.buf),
+                               view.len, payload_bytes, nullptr);
+  PyBuffer_Release(&view);
+  return result;
 }
 
 static PyMethodDef kMethods[] = {
     {"scan", scan, METH_O,
      "scan(buffer) -> (list[(type, channel, payload)], consumed)"},
+    {"scan_views", scan_views, METH_O,
+     "scan_views(buffer) -> (list[(type, channel, memoryview)], consumed)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
