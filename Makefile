@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control perf-gate lint clean
 
 all: proto native
 
@@ -108,6 +108,17 @@ bench-kernel:
 bench-ingest: native
 	python bench.py --ingest-only
 
+# the control-plane scenario alone: the tenant-skew replay (a
+# 12-request flood submitted ahead of a 2-request victim tenant)
+# served FIFO vs tenant-fair weighted-DRR, interleaved passes — the
+# victim's p95 claim-relative first-token latency ratio is the
+# fairness figure the perf gate bands — plus the k-shed-under-burn
+# and autoscale (spawn + byte-identical drain) actuation exercises
+# (writes artifacts/bench_control.json; the full `make bench` run
+# carries the same scenario inside bench_e2e.json's v11 control block)
+bench-control:
+	python bench.py --control-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -130,6 +141,8 @@ perf-gate:
 		--baseline artifacts/bench_kernel.json --current artifacts/bench_kernel.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_ingest.json --current artifacts/bench_ingest.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_control.json --current artifacts/bench_control.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

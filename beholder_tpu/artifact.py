@@ -59,7 +59,14 @@ Schema (``validate`` is the authoritative checker)::
                  "native_msgs_per_sec": 0.0,
                  "python_msgs_per_sec": 0.0,
                  "mean_batch_size": 0.0,
-                 "batched_msgs": 0.0}  # v10: batched native ingest
+                 "batched_msgs": 0.0},  # v10: batched native ingest
+      "control": {"victim_ttft_ratio": 0.0,
+                  "tail_fairness_ratio": 0.0,
+                  "uncontrolled_fairness_ratio": 0.0,
+                  "admitted_by_tenant": {},
+                  "shed_by_tenant": {},
+                  "k_shed_events": 0.0,
+                  "scale_events": 0.0}  # v11: control plane
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -140,6 +147,19 @@ gate bands it, degradation = the ratio FALLING), the absolute msg/s on
 each side (reported, never gated — the BENCH_NOTES drift doctrine),
 and the batch-formation evidence (mean dispatched batch size, messages
 that rode a batch). v1-v9 artifacts remain valid.
+
+Schema v11 (the control-plane PR): the run's fairness/actuation
+evidence rides along (:meth:`ArtifactRecorder.record_control`) —
+``victim_ttft_ratio`` (the tenant-skew replay's victim p95
+claim-relative latency, CONTROLLED / UNCONTROLLED, both replays
+interleaved on the same host in the same session; < 1 means the
+fair-admission plane protected the minority tenant, and the perf gate
+bands it — degradation = the ratio RISING back toward the FIFO burial),
+``tail_fairness_ratio`` (controlled victim p95 / flooding-tenant p95 —
+the per-tenant tail-fairness figure, also banded higher-fails),
+the uncontrolled ratio for the reader, per-tenant admission/shed
+attribution, and the k-shed/scale actuation counts. v1-v10 artifacts
+remain valid.
 """
 
 from __future__ import annotations
@@ -151,7 +171,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -252,6 +272,19 @@ EMPTY_INGEST = {
     "batched_msgs": 0.0,
 }
 
+#: v11: the control block's required shape (an empty block is valid —
+#: a run that never replayed the control scenarios still writes a v11
+#: artifact)
+EMPTY_CONTROL = {
+    "victim_ttft_ratio": 0.0,
+    "tail_fairness_ratio": 0.0,
+    "uncontrolled_fairness_ratio": 0.0,
+    "admitted_by_tenant": {},
+    "shed_by_tenant": {},
+    "k_shed_events": 0.0,
+    "scale_events": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -335,6 +368,7 @@ class ArtifactRecorder:
         self.slo: dict[str, Any] = copy.deepcopy(EMPTY_SLO)
         self.kernel: dict[str, Any] = copy.deepcopy(EMPTY_KERNEL)
         self.ingest: dict[str, float] = dict(EMPTY_INGEST)
+        self.control: dict[str, Any] = copy.deepcopy(EMPTY_CONTROL)
 
     def section(
         self,
@@ -511,6 +545,19 @@ class ArtifactRecorder:
                 raise ValueError(f"ingest summary missing {key!r}")
         self.ingest = {key: float(summary[key]) for key in EMPTY_INGEST}
 
+    def record_control(self, summary: dict[str, Any]) -> None:
+        """Adopt one control-plane replay summary as the run's v11
+        ``control`` block. Last writer wins — the block carries the
+        HEADLINE tenant-skew replay's fairness ratios (quantile ratios
+        don't sum across scenarios); per-scenario detail lives in the
+        bench section + raw timings."""
+        for key in EMPTY_CONTROL:
+            if key not in summary:
+                raise ValueError(f"control summary missing {key!r}")
+        self.control = copy.deepcopy(
+            {key: summary[key] for key in EMPTY_CONTROL}
+        )
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -558,6 +605,7 @@ class ArtifactRecorder:
             "slo": copy.deepcopy(self.slo),
             "kernel": copy.deepcopy(self.kernel),
             "ingest": dict(self.ingest),
+            "control": copy.deepcopy(self.control),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -664,6 +712,14 @@ def record_kernel(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_kernel(summary)
+
+
+def record_control(summary: dict) -> None:
+    """Adopt a control-plane replay summary into the active recorder's
+    v11 ``control`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_control(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -840,6 +896,24 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"ingest.{key} must be a number, "
                         f"got {ingest.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 11:
+        # v11: control-plane fairness/actuation evidence
+        control = obj.get("control")
+        if not isinstance(control, dict):
+            problems.append("control must be a dict (schema v11+)")
+        else:
+            for key in EMPTY_CONTROL:
+                if key in ("admitted_by_tenant", "shed_by_tenant"):
+                    if not isinstance(control.get(key), dict):
+                        problems.append(
+                            f"control.{key} must be a dict, "
+                            f"got {control.get(key)!r}"
+                        )
+                elif not isinstance(control.get(key), (int, float)):
+                    problems.append(
+                        f"control.{key} must be a number, "
+                        f"got {control.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

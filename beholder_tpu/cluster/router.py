@@ -96,15 +96,28 @@ class ClusterScheduler:
         flight_recorder=None,
         prefix_cache_factory=None,
         spec=None,
+        control_plane=None,
         **batcher_kwargs,
     ):
-        from beholder_tpu.models.serving import ContinuousBatcher
         from beholder_tpu.parallel.mesh import serving_shard_devices
-        from beholder_tpu.reliability.shed import IntakeQueue
 
         self.cluster = cluster
         self.model = model
+        self.params = params
         self.flight_recorder = flight_recorder
+        self._metrics = metrics
+        self._tracer = tracer
+        self._prefix_cache_factory = prefix_cache_factory
+        self._spec = spec
+        self._batcher_kwargs = dict(batcher_kwargs)
+        #: optional SLO-acting control plane
+        #: (:class:`beholder_tpu.control.ControlPlane`; None — the
+        #: default — keeps routing, intakes and shard count exactly the
+        #: pre-control cluster, byte-identically): shard intakes become
+        #: tenant-fair DRR queues, routing consults the deadline/tail
+        #: policy, spec controllers shed k under burn, and run_pending
+        #: boundaries evaluate the autoscaler
+        self.control_plane = control_plane
         self._registry = (
             getattr(metrics, "registry", metrics)
             if metrics is not None
@@ -119,48 +132,12 @@ class ClusterScheduler:
 
         n_workers = cluster.n_decode_workers + cluster.n_prefill_workers
         devices = serving_shard_devices(n_workers)
+        #: devices handed out so far — scale_up() continues the cycle
+        self._devices_used = n_workers
 
         self.shards: list[_Shard] = []
         for i in range(cluster.n_decode_workers):
-            batcher = ContinuousBatcher(
-                model,
-                params,
-                metrics=metrics,
-                tracer=tracer,
-                flight_recorder=flight_recorder,
-                prefix_cache=(
-                    prefix_cache_factory()
-                    if prefix_cache_factory is not None
-                    else None
-                ),
-                spec=spec,
-                **batcher_kwargs,
-            )
-            # the pool partition IS the placement: this shard's pages,
-            # page table and params live on their own mesh device, so
-            # every dispatch the shard runs lands there
-            batcher.state = place_paged_state(batcher.state, devices[i])
-            batcher.params = place_paged_state(batcher.params, devices[i])
-            pool = ShardPool(i, batcher.num_pages, device=devices[i])
-            # the router owns the shard intakes: queued items are
-            # (submit sequence, request) pairs so run_pending() can
-            # hand results back in ADMISSION order across the whole
-            # cluster (the batcher's own contract) no matter how
-            # routing and rebalance interleaved the shards
-            intake = IntakeQueue(
-                cluster.max_pending_per_shard,
-                max_cost=(
-                    cluster.max_pending_pages_per_shard
-                    if cluster.max_pending_pages_per_shard is not None
-                    else batcher.num_pages
-                ),
-                cost_fn=lambda item, b=batcher: b._need_pages(item[1]),
-                metrics=metrics,
-                name=f"cluster.{pool.name}",
-                labelled_sheds=True,
-            )
-            batcher.intake = intake
-            self.shards.append(_Shard(pool, batcher, intake))
+            self.shards.append(self._build_shard(i, devices[i]))
         self.pool_view = ShardedPoolView([s.pool for s in self.shards])
 
         self.prefill_workers: list[PrefillWorker] = [
@@ -207,6 +184,131 @@ class ClusterScheduler:
         #: call's recovery passes (a recovered request's re-claim lands
         #: on the same timeline as a new leg)
         self._gid_epoch = 0
+
+    # -- shard construction / scaling ------------------------------------
+
+    def _build_shard(self, shard_id: int, device) -> _Shard:
+        """One decode shard exactly as ``__init__`` builds them — also
+        the autoscaler's :meth:`scale_up` path, so a spawned shard is
+        indistinguishable from a boot-time one (same batcher knobs,
+        same placement, same intake policy)."""
+        from beholder_tpu.models.serving import ContinuousBatcher
+        from beholder_tpu.reliability.shed import IntakeQueue
+
+        batcher = ContinuousBatcher(
+            self.model,
+            self.params,
+            metrics=self._metrics,
+            tracer=self._tracer,
+            flight_recorder=self.flight_recorder,
+            prefix_cache=(
+                self._prefix_cache_factory()
+                if self._prefix_cache_factory is not None
+                else None
+            ),
+            spec=self._spec,
+            **self._batcher_kwargs,
+        )
+        # the pool partition IS the placement: this shard's pages,
+        # page table and params live on their own mesh device, so
+        # every dispatch the shard runs lands there
+        batcher.state = place_paged_state(batcher.state, device)
+        batcher.params = place_paged_state(batcher.params, device)
+        pool = ShardPool(shard_id, batcher.num_pages, device=device)
+        # the router owns the shard intakes: queued items are
+        # (submit sequence, request) pairs so run_pending() can
+        # hand results back in ADMISSION order across the whole
+        # cluster (the batcher's own contract) no matter how
+        # routing and rebalance interleaved the shards
+        intake_kwargs = dict(
+            max_cost=(
+                self.cluster.max_pending_pages_per_shard
+                if self.cluster.max_pending_pages_per_shard is not None
+                else batcher.num_pages
+            ),
+            cost_fn=lambda item, b=batcher: b._need_pages(item[1]),
+            metrics=self._metrics,
+            name=f"cluster.{pool.name}",
+            labelled_sheds=True,
+        )
+        if self.control_plane is not None:
+            # tenant-fair admission: the shard's intake drains in
+            # weighted DRR order and preempts over-share tenants under
+            # pressure; preempted items resolve to explicit outcomes
+            # in their admission-order result positions
+            intake = self.control_plane.intake(
+                self.cluster.max_pending_per_shard,
+                on_preempt=self._make_on_preempt(pool),
+                **intake_kwargs,
+            )
+            if self._spec is not None:
+                self.control_plane.attach_spec(batcher)
+        else:
+            intake = IntakeQueue(
+                self.cluster.max_pending_per_shard, **intake_kwargs
+            )
+        batcher.intake = intake
+        return _Shard(pool, batcher, intake)
+
+    def _make_on_preempt(self, pool):
+        """Preemption resolution for one shard's tenant-fair intake:
+        release the submit-time page reservation, park an explicit
+        :class:`~beholder_tpu.control.admission.Preempted` outcome in
+        the request's admission-order result position, and emit the
+        ``req.dropped`` lifecycle instant so the SLO layer classifies
+        the loss (a preempted request must never read as attainment)."""
+
+        def on_preempt(item, tenant):
+            from beholder_tpu.control.admission import Preempted
+
+            seq, request = item
+            pool.release(self._need(request))
+            self._pending_drops[seq] = Preempted(tenant)
+            if self.flight_recorder is not None:
+                # tenant rides the instant: a preempted request never
+                # claimed, so the SLO fold has no open entry to read
+                # the tenant from — without it the victim tenant's burn
+                # would stay blind to exactly the loss the control
+                # plane inflicted
+                tenant_note = (
+                    {"tenant": tenant} if tenant is not None else {}
+                )
+                self.flight_recorder.instant(
+                    "req.dropped", gid=f"s{seq}",
+                    reason="tenant_preempted", **tenant_note,
+                )
+
+        return on_preempt
+
+    def scale_up(self) -> _Shard:
+        """Spawn one decode shard (the autoscaler's scale-UP actuator;
+        also callable directly for manual capacity adds): a fresh pool
+        + batcher on the next mesh device in the cycle, routable
+        immediately. The inverse is :meth:`drain` — PR 8's
+        byte-identical migration — so capacity changes in either
+        direction lose nothing."""
+        from beholder_tpu.parallel.mesh import serving_shard_devices
+
+        device = serving_shard_devices(self._devices_used + 1)[-1]
+        self._devices_used += 1
+        shard = self._build_shard(len(self.shards), device)
+        self.shards.append(shard)
+        self.pool_view.shards.append(shard.pool)
+        if self.failover is not None:
+            from .failover import WORKER_UP
+
+            self.failover._set_state(shard.pool.name, WORKER_UP)
+        if self.instruments is not None:
+            self.instruments.shards.set(
+                sum(
+                    1 for s in self.shards
+                    if self.failover is None
+                    or self.failover.state(s.pool.name)
+                    not in ("down", "drained")
+                )
+            )
+        self.pool_view.refresh_gauges(self.instruments)
+        return shard
 
     # -- introspection ---------------------------------------------------
 
@@ -330,15 +432,44 @@ class ClusterScheduler:
                 worker=shard.pool.name, reason=reason, need=int(need),
             )
 
-    def _route(self, need: int) -> _Shard:
+    def _route(self, need: int, request=None) -> _Shard:
         """Pick the shard for one request of worst-case ``need`` pages
         and record the decision (counter + recorder-only event). Under
         failover only UP shards are candidates — a down/draining shard
-        is invisible to routing."""
+        is invisible to routing. With a control plane whose routing
+        actuator is armed, placement consults the deadline-slack +
+        tail-avoidance policy (:meth:`beholder_tpu.control.policy.
+        ControlPlane.route_shard`) — decisions it overrides land on
+        ``beholder_cluster_routes_total{reason}`` as
+        ``control_tail_avoid``/``control_deadline``; without it (or
+        when the policy agrees with plain pressure) routing is
+        byte-identical to the pre-control cluster."""
         ts = time.time()
         t0 = time.perf_counter()
         candidates = self._routable()
-        if len(candidates) == 1:
+        controlled = None
+        if self.control_plane is not None and len(candidates) > 1:
+            controlled = self.control_plane.route_shard(
+                candidates, need, request
+            )
+            if (
+                controlled is not None
+                and controlled[1] == "pressure"
+                and self.cluster.route_policy == ROUTE_ROUND_ROBIN
+            ):
+                # the control policy had nothing to override (no tail
+                # inflation, no urgent deadline): a round-robin cluster
+                # keeps round-robining — control must not silently
+                # replace the configured default policy
+                controlled = None
+        if controlled is not None:
+            shard, control_reason = controlled
+            reason = (
+                "pressure"
+                if control_reason == "pressure"
+                else f"control_{control_reason}"
+            )
+        elif len(candidates) == 1:
             shard, reason = candidates[0], "only_shard"
         elif self.cluster.route_policy == ROUTE_ROUND_ROBIN:
             shard = candidates[self._rr % len(candidates)]
@@ -472,7 +603,7 @@ class ClusterScheduler:
                             SHED_SHARD_DOWN, key=gid_of.get(key)
                         )
                         continue
-                shard = self._route(need)
+                shard = self._route(need, request=req)
                 shard.pool.reserve(need)
                 assignments[shard.pool.shard_id].append((key, req, need))
             pending = []
@@ -591,7 +722,7 @@ class ClusterScheduler:
                     else SHED_OVERSIZED
                 )
                 return fo.shed(reason)
-        shard = self._route(need)
+        shard = self._route(need, request=request)
         batcher = shard.batcher
         if need > batcher.num_pages or need > batcher.max_pages_per_seq:
             # unservable at ANY load (the batcher's own submit rule)
@@ -617,10 +748,18 @@ class ClusterScheduler:
         survivors, failures mid-serve recover, and items nothing can
         hold (plus drain-time ``shard_down`` drops) resolve to
         explicit :class:`~beholder_tpu.cluster.failover.Dropped`
-        outcomes in their admission-order positions."""
+        outcomes in their admission-order positions. Preempted items
+        (tenant-fair intakes under a control plane) resolve the same
+        way — an explicit :class:`~beholder_tpu.control.admission.
+        Preempted` in the request's position, either mode."""
+        if self.control_plane is not None:
+            # the autoscaler decision point: BETWEEN serves, never mid-
+            # flight (scale-down is a drain — it must see settled pools)
+            self.control_plane.evaluate_scaling(self)
         if self.failover is not None:
             return self._run_pending_failover()
         self._rebalance()
+        drops, self._pending_drops = self._pending_drops, {}
         collected: list[tuple[int, np.ndarray]] = []
         for shard in self.shards:
             pending, drain_waits, _ = shard.intake.drain_all()
@@ -651,6 +790,7 @@ class ClusterScheduler:
                     len(pending), shard=str(shard.pool.shard_id)
                 )
         self.pool_view.refresh_gauges(self.instruments)
+        collected.extend(drops.items())
         collected.sort(key=lambda pair: pair[0])
         return [result for _, result in collected]
 

@@ -1,0 +1,316 @@
+"""The SLO-acting control plane: close the loop from burn to action.
+
+EXTENSION BEYOND THE REFERENCE (which serves nothing — SURVEY.md §0).
+PR 9 gave the serving engine SENSES — per-request TTFT/TPOT timelines,
+streaming P² digests, multi-window error-budget burn rates — but left
+every actuator open-loop: admission, speculation, routing and shard
+count all ignored the signals while burn rates paged into the void.
+This subsystem is the ACTING half (ROADMAP item 2, the "millions of
+users, diverse scenarios" item), in the spirit of GPUOS's OS-style
+primitive for multiplexing one shared accelerator across competing
+workloads (PAPERS.md): the paged pool, the speculation budget and the
+shard fleet become resources a policy layer schedules against declared
+objectives. Four actuators, one policy engine:
+
+- **Tenant-fair admission** (:mod:`.admission`).
+  :class:`~beholder_tpu.models.serving.Request` grew a ``tenant`` id
+  that threads claim instants → timelines → per-tenant digests and
+  burn (:mod:`beholder_tpu.obs.slo`);
+  :class:`~beholder_tpu.control.admission.TenantFairQueue` — a
+  drop-in :class:`~beholder_tpu.reliability.shed.IntakeQueue` — drains
+  in weighted deficit-round-robin order (a flooding tenant cannot
+  starve the others: service interleaves by weight, ±1 deficit),
+  enforces per-tenant quotas (``tenant_quota`` sheds), and under queue
+  pressure admits an under-share tenant by PREEMPTING the most
+  over-share tenant's newest queued request (shed the over-quota
+  tenant, not the newcomer) — preempted requests resolve to an
+  explicit :class:`~beholder_tpu.control.admission.Preempted` outcome.
+- **SLO-aware speculation** (:meth:`ControlPlane.spec_k_cap`). The
+  adaptive-k controller stops merely TUNING k from acceptance: under
+  fast-window TTFT-tail burn it SHEDS k (draft work is the one load
+  the engine can drop without dropping requests), restoring it when
+  the window drains.
+- **Deadline- and burn-aware routing**
+  (:meth:`ControlPlane.route_shard`). The cluster router's pressure
+  policy gains a deadline-slack term (an urgent request prefers the
+  shallowest queue over the emptiest pool) and avoids shards whose
+  per-worker digests show tail inflation (p95 detaching from p50 —
+  a struggling shard looks fine by free pages alone).
+- **Autoscaler-shaped actuator** (:meth:`ControlPlane.evaluate_scaling`).
+  Sustained fast-window burn + pool pressure above the high watermark
+  spawns a decode shard (:meth:`~beholder_tpu.cluster.router.
+  ClusterScheduler.scale_up`); sustained calm below the low watermark
+  drains one — the scale-DOWN path is PR 8's byte-identical
+  :meth:`~beholder_tpu.cluster.failover.FailoverEngine.drain`
+  migration, so removing capacity loses nothing (recovered streams
+  bitwise-identical to an uninterrupted run).
+
+Driven end-to-end by the bursty/adversarial replay harness
+(:mod:`.replay`): deterministic trace generators — flash crowds,
+shared-prefix storms, tenant skew, mixed prefill/decode, recovery
+storms — whose fairness and tail metrics commit to
+``artifacts/bench_control.json`` (schema v11 ``control`` block) and
+ride ``tools/perf_gate.py``'s ratio bands, so fairness is CI-pinned,
+not anecdotal.
+
+Everything is default-OFF behind ``instance.control.*`` (None from
+:func:`control_from_config` — the house contract: off ⇒ serving output
+and the /metrics exposition byte-identical, pinned by
+``tests/test_control.py``). This module stays import-light (no jax);
+the policy engine lives in :mod:`.policy` and loads on first use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TenantPolicy:
+    """One tenant's declared share of the intake.
+
+    ``weight`` scales the tenant's deficit-round-robin quantum (2.0
+    drains twice as much per cycle as 1.0); ``quota`` caps the
+    tenant's QUEUED requests (None = bounded only by the queue itself
+    — offers past it shed ``tenant_quota``)."""
+
+    weight: float = 1.0
+    quota: int | None = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.quota is not None and self.quota < 1:
+            raise ValueError(f"quota must be >= 1, got {self.quota}")
+
+
+@dataclass
+class SpecShedConfig:
+    """SLO-aware speculation knobs (``instance.control.spec.*``).
+
+    While the tracker's fast-window burn exceeds ``burn_threshold``
+    the adaptive-k controller's draft length is capped at
+    ``shed_to`` — draft work is shed load the engine can drop without
+    dropping requests (verify rounds shrink toward plain decode)."""
+
+    burn_threshold: float = 2.0
+    shed_to: int = 0
+
+    def __post_init__(self):
+        if self.burn_threshold <= 0:
+            raise ValueError(
+                f"burn_threshold must be > 0, got {self.burn_threshold}"
+            )
+        if self.shed_to < 0:
+            raise ValueError(f"shed_to must be >= 0, got {self.shed_to}")
+
+
+@dataclass
+class RoutingConfig:
+    """Deadline- and burn-aware routing knobs
+    (``instance.control.routing.*``).
+
+    A shard whose per-worker TTFT tail ratio (p95/p50 from the SLO
+    digests) exceeds ``tail_threshold`` is avoided while any
+    un-inflated shard fits the request; a request whose deadline slack
+    is under ``deadline_slack_s`` routes to the SHALLOWEST intake
+    among candidates (queue depth is TTFT; free pages are throughput)."""
+
+    tail_threshold: float = 3.0
+    deadline_slack_s: float = 1.0
+
+    def __post_init__(self):
+        if self.tail_threshold <= 1.0:
+            raise ValueError(
+                f"tail_threshold must be > 1, got {self.tail_threshold}"
+            )
+        if self.deadline_slack_s < 0:
+            raise ValueError(
+                f"deadline_slack_s must be >= 0, "
+                f"got {self.deadline_slack_s}"
+            )
+
+
+@dataclass
+class AutoscaleConfig:
+    """Autoscaler knobs (``instance.control.autoscale.*``).
+
+    Scale UP when fast-window burn > ``up_burn`` AND pool pressure
+    (committed/total pages) > ``up_pressure`` sustained ``sustain_s``;
+    scale DOWN (graceful byte-identical drain) when burn < ``down_burn``
+    AND pressure < ``down_pressure`` sustained the same window. Shard
+    count stays within [``min_shards``, ``max_shards``]; decisions are
+    at least ``cooldown_s`` apart (a flapping autoscaler is worse than
+    none)."""
+
+    min_shards: int = 1
+    max_shards: int = 4
+    up_burn: float = 2.0
+    up_pressure: float = 0.75
+    down_burn: float = 0.5
+    down_pressure: float = 0.25
+    sustain_s: float = 10.0
+    cooldown_s: float = 30.0
+
+    def __post_init__(self):
+        if self.min_shards < 1:
+            raise ValueError(
+                f"min_shards must be >= 1, got {self.min_shards}"
+            )
+        if self.max_shards < self.min_shards:
+            raise ValueError(
+                f"max_shards {self.max_shards} < min_shards "
+                f"{self.min_shards}"
+            )
+        if not 0.0 <= self.down_pressure <= self.up_pressure <= 1.0:
+            raise ValueError(
+                "need 0 <= down_pressure <= up_pressure <= 1, got "
+                f"{self.down_pressure}/{self.up_pressure}"
+            )
+        if self.down_burn >= self.up_burn:
+            raise ValueError(
+                f"down_burn {self.down_burn} must be < up_burn "
+                f"{self.up_burn} (hysteresis)"
+            )
+        if self.sustain_s < 0 or self.cooldown_s < 0:
+            raise ValueError("sustain_s/cooldown_s must be >= 0")
+
+
+@dataclass
+class ControlConfig:
+    """The control plane's declared policy (``instance.control.*``).
+
+    ``tenants`` maps tenant id → :class:`TenantPolicy`; requests whose
+    tenant has no entry (and untenanted requests, bucketed under
+    ``DEFAULT_TENANT``) get ``default_weight``/``default_quota``.
+    ``spec``/``routing``/``autoscale`` arm their actuators when
+    non-None; a config with all three None is a pure fair-admission
+    plane."""
+
+    tenants: dict[str, TenantPolicy] = field(default_factory=dict)
+    default_weight: float = 1.0
+    default_quota: int | None = None
+    spec: SpecShedConfig | None = None
+    routing: RoutingConfig | None = None
+    autoscale: AutoscaleConfig | None = None
+
+    def __post_init__(self):
+        if self.default_weight <= 0:
+            raise ValueError(
+                f"default_weight must be > 0, got {self.default_weight}"
+            )
+        if self.default_quota is not None and self.default_quota < 1:
+            raise ValueError(
+                f"default_quota must be >= 1, got {self.default_quota}"
+            )
+
+    def policy_for(self, tenant: str | None) -> TenantPolicy:
+        if tenant is not None and tenant in self.tenants:
+            return self.tenants[tenant]
+        return TenantPolicy(
+            weight=self.default_weight, quota=self.default_quota
+        )
+
+
+#: the bucket untenanted requests fall into for fairness arithmetic —
+#: an untenanted fleet is ONE tenant, so DRR degrades to plain FIFO
+DEFAULT_TENANT = "default"
+
+
+def control_from_config(config) -> ControlConfig | None:
+    """Parse ``instance.control.*`` into a :class:`ControlConfig`;
+    None unless ``instance.control.enabled`` — the same off-by-default
+    contract as cache/spec/cluster/slo (disabled means byte-identical
+    serving output and /metrics exposition, pinned by
+    ``tests/test_control.py``).
+
+    Keys: ``enabled``; ``tenants.<id>.{weight, quota}``;
+    ``default_weight``/``default_quota``;
+    ``spec.{enabled, burn_threshold, shed_to}``;
+    ``routing.{enabled, tail_threshold, deadline_slack_s}``;
+    ``autoscale.{enabled, min_shards, max_shards, up_burn,
+    up_pressure, down_burn, down_pressure, sustain_s, cooldown_s}``."""
+    node = config.get("instance.control")
+    if node is None or not node.get("enabled"):
+        return None
+    tenants: dict[str, TenantPolicy] = {}
+    tenant_node = node.get("tenants")
+    if tenant_node:
+        for tenant in tenant_node:  # ConfigNode iterates its keys
+            quota = node.get(f"tenants.{tenant}.quota")
+            tenants[str(tenant)] = TenantPolicy(
+                weight=float(node.get(f"tenants.{tenant}.weight", 1.0)),
+                quota=int(quota) if quota is not None else None,
+            )
+    spec = None
+    if bool(node.get("spec.enabled")):
+        spec = SpecShedConfig(
+            burn_threshold=float(node.get("spec.burn_threshold", 2.0)),
+            shed_to=int(node.get("spec.shed_to", 0)),
+        )
+    routing = None
+    if bool(node.get("routing.enabled")):
+        routing = RoutingConfig(
+            tail_threshold=float(node.get("routing.tail_threshold", 3.0)),
+            deadline_slack_s=float(
+                node.get("routing.deadline_slack_s", 1.0)
+            ),
+        )
+    autoscale = None
+    if bool(node.get("autoscale.enabled")):
+        autoscale = AutoscaleConfig(
+            min_shards=int(node.get("autoscale.min_shards", 1)),
+            max_shards=int(node.get("autoscale.max_shards", 4)),
+            up_burn=float(node.get("autoscale.up_burn", 2.0)),
+            up_pressure=float(node.get("autoscale.up_pressure", 0.75)),
+            down_burn=float(node.get("autoscale.down_burn", 0.5)),
+            down_pressure=float(
+                node.get("autoscale.down_pressure", 0.25)
+            ),
+            sustain_s=float(node.get("autoscale.sustain_s", 10.0)),
+            cooldown_s=float(node.get("autoscale.cooldown_s", 30.0)),
+        )
+    default_quota = node.get("default_quota")
+    return ControlConfig(
+        tenants=tenants,
+        default_weight=float(node.get("default_weight", 1.0)),
+        default_quota=(
+            int(default_quota) if default_quota is not None else None
+        ),
+        spec=spec,
+        routing=routing,
+        autoscale=autoscale,
+    )
+
+
+def __getattr__(name: str):
+    # lazy re-exports keep this module import-light (no jax at config
+    # parse time — the same pattern as beholder_tpu.spec)
+    if name in ("TenantFairQueue", "Preempted", "SHED_TENANT_QUOTA",
+                "SHED_TENANT_PREEMPTED"):
+        from . import admission
+
+        return getattr(admission, name)
+    if name == "ControlPlane":
+        from .policy import ControlPlane
+
+        return ControlPlane
+    if name in ("Scenario", "replay", "SCENARIOS"):
+        from . import replay
+
+        return getattr(replay, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "AutoscaleConfig",
+    "ControlConfig",
+    "ControlPlane",
+    "DEFAULT_TENANT",
+    "Preempted",
+    "RoutingConfig",
+    "SpecShedConfig",
+    "TenantFairQueue",
+    "TenantPolicy",
+    "control_from_config",
+]

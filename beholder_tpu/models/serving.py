@@ -1129,6 +1129,15 @@ class Request(NamedTuple):
     #: at every host scheduling event: claim and tick-chunk boundaries)
     #: instead of letting it wedge a slot through a recovery storm.
     deadline: object = None
+    #: optional tenant id (control subsystem): the workload this request
+    #: belongs to. None (the default) changes nothing; set, the
+    #: tenant-fair intake (:class:`beholder_tpu.control.admission.
+    #: TenantFairQueue`) schedules it under weighted deficit-round-robin
+    #: + per-tenant quotas, the recorder-only ``req.claim`` instant
+    #: carries it so the SLO layer folds PER-TENANT digests and burn,
+    #: and the ``beholder_control_*`` catalog attributes admissions and
+    #: sheds to it.
+    tenant: str | None = None
 
 
 class DeadlineExceededResult:
@@ -1757,12 +1766,20 @@ class ContinuousBatcher:
                 if fr is not None:
                     # the request-level lifecycle marker the SLO/
                     # timeline layer folds (obs/timeline.py): claim
-                    # time anchors queue-wait and TTFT
+                    # time anchors queue-wait and TTFT. A tenant id
+                    # rides along ONLY when set — an untenanted fleet's
+                    # event shape is unchanged
+                    tenant_note = (
+                        {"tenant": req.tenant}
+                        if getattr(req, "tenant", None) is not None
+                        else {}
+                    )
                     fr.instant(
                         "req.claim", trace_id=claim_tid, rid=rid,
                         slot=slot, prefix_tokens=int(t),
                         hit_pages=len(hit_pages),
                         horizon=int(req.horizon),
+                        **tenant_note,
                         **self._run_notes.get(rid, {}),
                     )
         finally:
@@ -1932,8 +1949,21 @@ class ContinuousBatcher:
         if self.intake is None:
             raise RuntimeError("no intake queue configured")
         pending, waits, _ = self.intake.drain_all()
+        # tenant-fair intakes (control subsystem) may have preempted
+        # previously-accepted items under pressure: resolve each to an
+        # explicit Preempted outcome APPENDED to this drain's results —
+        # an accepted request is never silently lost. A plain
+        # IntakeQueue has no take_preempted, and the import only
+        # happens when something was actually preempted.
+        take_preempted = getattr(self.intake, "take_preempted", None)
+        preempted = take_preempted() if take_preempted is not None else []
+        tail: list = []
+        if preempted:
+            from beholder_tpu.control.admission import Preempted
+
+            tail = [Preempted(tenant) for _, tenant in preempted]
         if not pending:
-            return []
+            return tail
         if self.flight_recorder is not None:
             # intake residency (measured at the drain, read atomically
             # with the items) rides the timeline: the SLO layer's
@@ -1945,10 +1975,10 @@ class ContinuousBatcher:
         if waves is None:
             waves = self.prefix_cache is None and self.spec is None
         if waves:
-            return self.run_waves(pending)
+            return self.run_waves(pending) + tail
         if self.spec is not None:
-            return self.run_spec(pending)
-        return self.run(pending)
+            return self.run_spec(pending) + tail
+        return self.run(pending) + tail
 
     # -- speculative path: draft-then-verify ----------------------------
 
@@ -2418,10 +2448,16 @@ class ContinuousBatcher:
                     # the fused path's lifecycle marker: claim = wave
                     # membership (the wave slice that follows is the
                     # request's admission AND its first token)
+                    tenant_note = (
+                        {"tenant": req.tenant}
+                        if getattr(req, "tenant", None) is not None
+                        else {}
+                    )
                     self.flight_recorder.instant(
                         "req.claim", rid=rid, slot=len(wave) - 1,
                         prefix_tokens=len(req.progress) - 1,
                         horizon=int(req.horizon),
+                        **tenant_note,
                         **self._run_notes.get(rid, {}),
                     )
             if not wave:

@@ -163,7 +163,16 @@ class _Conn(asyncio.Protocol):
             self._on_frame(frame)
         if self._pump_soon:
             self._pump_soon = False
-            self.server.pump()
+            # batch across CONNECTIONS (the item-4 leftover): defer to
+            # one loop-scheduled sweep instead of pumping inline — when
+            # several connections' polls land in the same event-loop
+            # iteration (4 producers publishing under load), their
+            # queue mutations coalesce into ONE delivery sweep and one
+            # socket write per consumer, not one sweep per producer.
+            # The wire bytes are identical (same frames, same per-queue
+            # FIFO, same round-robin; pinned by tests/test_control.py)
+            # — only the sweep count drops.
+            self.server.schedule_pump()
 
     # -- helpers ------------------------------------------------------------
     def _send(self, frame: codec.Frame) -> None:
@@ -421,6 +430,15 @@ class AmqpTestServer:
         self._thread: threading.Thread | None = None
         self._server: asyncio.AbstractServer | None = None
         self._rr: dict[str, int] = {}
+        #: cross-connection pump coalescing: True while a sweep is
+        #: already scheduled on the loop (further schedule_pump calls
+        #: from OTHER connections' polls in the same iteration fold
+        #: into it)
+        self._pump_scheduled = False
+        #: delivery sweeps actually run — the batching evidence the
+        #: tests pin (N connections' same-iteration polls must cost
+        #: ~1 sweep, not N)
+        self.pump_sweeps = 0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
@@ -536,13 +554,44 @@ class AmqpTestServer:
         return moved
 
     # -- scheduling ---------------------------------------------------------
+    def schedule_pump(self) -> None:
+        """Coalesce pump requests across connections: the FIRST caller
+        in an event-loop iteration schedules one sweep via
+        ``call_soon``; every further request before it runs folds into
+        it. With N producer connections' polls arriving in the same
+        iteration the broker runs ONE delivery sweep over all their
+        publishes (one write per consumer) instead of N sweeps —
+        the cross-connection twin of ``_pump_soon``'s
+        pump-once-per-recv. Wire bytes are unchanged: the deferred
+        sweep walks the same queues in the same order over the same
+        FIFO contents. Callable from any thread (falls back to a
+        threadsafe call when invoked off-loop; a direct ``pump()``
+        remains available for loop-less unit use)."""
+        if self._pump_scheduled or self._loop is None:
+            return
+        self._pump_scheduled = True
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self._loop:
+            self._loop.call_soon(self._scheduled_pump)
+        else:
+            self._loop.call_soon_threadsafe(self._scheduled_pump)
+
+    def _scheduled_pump(self) -> None:
+        self._pump_scheduled = False
+        self.pump()
+
     def pump(self) -> None:
         """Deliver queued messages to consumers with free prefetch slots
         (after expiring TTL-overdue heads into their DLQs). Each sweep
         coalesces one connection's deliveries into ONE socket write —
         a 30-message drain used to cost 30 send syscalls and wake the
         consumer 30 times; now it is one segment the consumer's batched
-        ingest path scans in one native pass."""
+        ingest path scans in one native pass. Cross-connection
+        coalescing lives in :meth:`schedule_pump`."""
+        self.pump_sweeps += 1
         if self._message_ttl:
             self._expire(time.monotonic())
         writes: dict[_Conn, bytearray] = {}

@@ -279,6 +279,12 @@ class SLOTracker:
             "fast": _Window(self.config.fast_window_s),
             "slow": _Window(self.config.slow_window_s),
         }
+        #: per-tenant state (control subsystem): TTFT digests + a
+        #: fast-window good/bad count per tenant id, built lazily on
+        #: the first tenanted observation — an untenanted fleet holds
+        #: not one extra byte here. The control plane's tenant-fair
+        #: admission and the /slo "tenants" block read these.
+        self._tenants: dict[str, dict[str, Any]] = {}
         #: streaming fold state: open request key -> lifecycle scratch
         self._open: dict[Any, dict[str, Any]] = {}
         self._metrics = None
@@ -368,6 +374,7 @@ class SLOTracker:
                 "first_us": None,
                 "worker": args.get("worker"),
                 "slot": args.get("slot"),
+                "tenant": args.get("tenant"),
             }
         elif name == "req.recovered":
             entry = self._open.get(_key_of(event))
@@ -396,6 +403,7 @@ class SLOTracker:
                 key=_key_of(event),
                 queue_wait_s=entry["queue_wait_s"],
                 outcome=args.get("outcome", "ok"),
+                tenant=entry.get("tenant"),
             )
         elif name == "req.dropped":
             # the failover layer lost this request (recovery_limit /
@@ -415,6 +423,11 @@ class SLOTracker:
                     entry["queue_wait_s"] if entry else 0.0
                 ),
                 outcome="dropped",
+                # never-claimed drops (queued preemptions) have no open
+                # entry — the instant itself carries the tenant
+                tenant=args.get("tenant") or (
+                    entry.get("tenant") if entry else None
+                ),
             )
         elif name == "deadline_exceeded" and args.get("stage") == "claim":
             # expired while QUEUED (the recovery-storm overload mode):
@@ -435,6 +448,7 @@ class SLOTracker:
                 key=_key_of(event),
                 queue_wait_s=float(args.get("queue_wait_s") or 0.0),
                 outcome="deadline_exceeded",
+                tenant=entry.get("tenant") if entry else None,
             )
         elif name in ("admit", "wave") and event.get("ph") == "X":
             end = int(event.get("ts_us", 0)) + int(event.get("dur_us", 0))
@@ -477,17 +491,21 @@ class SLOTracker:
         key: Any = None,
         queue_wait_s: float = 0.0,
         outcome: str = "ok",
+        tenant: str | None = None,
     ) -> bool:
         """Classify one completed request against the objectives and
-        fold its latencies into the digests/windows. Returns the
-        good/bad verdict."""
+        fold its latencies into the digests/windows (plus the tenant's
+        own digest/window when a ``tenant`` id is attached). Returns
+        the good/bad verdict."""
         with self._lock:
             return self._observe(
-                ttft_s, tpot_s, worker, key, queue_wait_s, outcome
+                ttft_s, tpot_s, worker, key, queue_wait_s, outcome,
+                tenant,
             )
 
     def _observe(
-        self, ttft_s, tpot_s, worker, key, queue_wait_s, outcome
+        self, ttft_s, tpot_s, worker, key, queue_wait_s, outcome,
+        tenant=None,
     ) -> bool:
         cfg = self.config
         good = (
@@ -508,6 +526,18 @@ class SLOTracker:
             digest["ttft"].observe(ttft_s)
             if tpot_s is not None:
                 digest["tpot"].observe(tpot_s)
+        if tenant is not None:
+            entry = self._tenants.get(tenant)
+            if entry is None:
+                entry = self._tenants[tenant] = {
+                    "ttft": LatencyDigest(),
+                    "good": 0,
+                    "bad": 0,
+                    "window": _Window(self.config.fast_window_s),
+                }
+            entry["ttft"].observe(ttft_s)
+            entry["good" if good else "bad"] += 1
+            entry["window"].add(now, good)
         self._queue_wait.observe(queue_wait_s)
         if (
             not self.worst_request
@@ -564,6 +594,45 @@ class SLOTracker:
         """1 - slow-window burn: the budget left at the current pace
         (negative means the window already overspent it)."""
         return 1.0 - self.burn_rate("slow")
+
+    # -- control-plane accessors (the acting half reads these) -----------
+
+    def scope_tail_ratio(self, scope: str = CLUSTER_SCOPE) -> float:
+        """p95/p50 TTFT of one digest scope — the tail-inflation signal
+        the control plane's routing policy avoids shards on (a worker
+        whose tail detaches from its median is struggling even when its
+        pool shows free pages). 0.0 until the scope has digested a
+        request with a nonzero median."""
+        with self._lock:
+            digest = self._digests.get(scope)
+            if digest is None:
+                return 0.0
+            p50 = digest["ttft"].quantile(0.5)
+            if p50 <= 0.0:
+                return 0.0
+            return digest["ttft"].quantile(0.95) / p50
+
+    def tenant_burn(self, tenant: str) -> float:
+        """Fast-window error-budget burn for ONE tenant (0.0 for a
+        tenant never observed) — the per-tenant page the fair-admission
+        layer prioritizes under pressure."""
+        with self._lock:
+            entry = self._tenants.get(tenant)
+            if entry is None:
+                return 0.0
+            good, bad = entry["window"].totals(self._clock())
+            total = good + bad
+            if not total:
+                return 0.0
+            return (bad / total) / (1.0 - self.config.target)
+
+    def tenant_stats(self) -> dict[str, dict[str, Any]]:
+        """Per-tenant snapshot: request verdicts, streaming TTFT
+        quantiles (ms), and the fast-window burn — the /slo and
+        /control ``tenants`` block, and the replay harness's fairness
+        evidence."""
+        with self._lock:
+            return self._tenants_snapshot()
 
     def health(self) -> tuple[bool, Any]:
         """The ``/healthz`` contract: unhealthy while the fast-window
@@ -624,7 +693,29 @@ class SLOTracker:
             },
             "open_requests": len(self._open),
             "dropped_open": self.dropped_open,
+            # per-tenant digests/burn (control subsystem): empty for an
+            # untenanted fleet — the key is additive, never renamed
+            "tenants": self._tenants_snapshot(),
         }
+
+    def _tenants_snapshot(self) -> dict[str, Any]:
+        now = self._clock()
+        out: dict[str, Any] = {}
+        for tenant, entry in sorted(self._tenants.items()):
+            good, bad = entry["window"].totals(now)
+            total = good + bad
+            out[tenant] = {
+                "good": entry["good"],
+                "bad": entry["bad"],
+                "ttft_ms": entry["ttft"].to_dict(unit_scale=1e3),
+                "burn_fast": round(
+                    (bad / total) / (1.0 - self.config.target)
+                    if total
+                    else 0.0,
+                    4,
+                ),
+            }
+        return out
 
     def artifact_summary(self) -> dict[str, Any]:
         """The bench artifact's schema-v8 ``slo`` block."""

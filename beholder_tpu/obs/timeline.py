@@ -103,6 +103,9 @@ class RequestTimeline:
     prefix_tokens: int = 0
     tokens: int = 0
     outcome: str = "incomplete"
+    #: tenant id from the claim instant (control subsystem); None for
+    #: an untenanted request
+    tenant: str | None = None
     ttft_s: float | None = None
     tpot_s: float | None = None
     recovery_s: float = 0.0
@@ -133,6 +136,7 @@ class RequestTimeline:
                 self.key if isinstance(self.key, (str, int, float))
                 else list(self.key)
             ),
+            "tenant": self.tenant,
             "queue_wait_s": round(self.queue_wait_s, 6),
             "horizon": self.horizon,
             "prefix_tokens": self.prefix_tokens,
@@ -215,6 +219,8 @@ def build_timelines(events: Iterable[dict[str, Any]]) -> TimelineReport:
                 record.horizon = int(args["horizon"])
             if args.get("prefix_tokens"):
                 record.prefix_tokens = int(args["prefix_tokens"])
+            if args.get("tenant") is not None:
+                record.tenant = args["tenant"]
         elif name == "req.retire":
             record = records.get(_key_of(event))
             if record is None or not record.legs:

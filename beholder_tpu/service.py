@@ -301,6 +301,34 @@ class BeholderService:
         #: serves to completion before the process exits).
         self.cluster_scheduler = None
 
+        #: optional SLO-acting control plane (``instance.control.*``;
+        #: OFF by default ⇒ serving output and the default exposition
+        #: stay byte-identical — the same contract as every subsystem
+        #: knob, pinned by tests/test_control.py). The service parses
+        #: the declared policy (service.control) and builds the
+        #: host-side policy engine (service.control_plane — it reads
+        #: the SLO tracker, holds no device state) for whatever embeds
+        #: the serving layer: ``ClusterScheduler(...,
+        #: control_plane=service.control_plane)`` arms tenant-fair
+        #: shard intakes, burn/deadline-aware routing and the
+        #: autoscaler; ``control_plane.attach_spec(batcher)`` arms
+        #: burn-driven k-shedding; ``control_plane.intake(...)`` builds
+        #: a tenant-fair intake for a bare batcher. The metrics server
+        #: gains ``GET /control`` (policy + live per-tenant state).
+        from beholder_tpu.control import control_from_config
+
+        self.control = control_from_config(config)
+        self.control_plane = None
+        if self.control is not None:
+            from beholder_tpu.control.policy import ControlPlane
+
+            self.control_plane = ControlPlane(
+                self.control,
+                tracker=self.slo,
+                registry=self.metrics.registry,
+                flight_recorder=self.flight_recorder,
+            )
+
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
             config.get("keys.trello.key", ""),
@@ -829,6 +857,12 @@ def init(
         #: the SIGTERM export to see the timeline
         if service.slo is not None:
             metrics.add_route("/slo", service.slo.route())
+        if service.control_plane is not None:
+            # GET /control: the declared policy + live per-tenant
+            # admission state + actuator log (the acting half's /slo)
+            metrics.add_route(
+                "/control", service.control_plane.http_route()
+            )
         if service.flight_recorder is not None:
             metrics.add_route(
                 "/debug/flight", service.flight_recorder.route()

@@ -69,13 +69,30 @@ class AdaptiveDraftController:
         self.decay = cfg.ema
         self._init = 0.5
         self.ema = np.full(slots, self._init, np.float64)
+        #: control-plane hooks (beholder_tpu.control): ``k_cap_fn``
+        #: returns a draft-length cap to apply RIGHT NOW (None =
+        #: uncapped — the default, under which choose() is exactly the
+        #: acceptance-EMA tuner), and ``on_k_shed(slot, wanted, cap)``
+        #: reports each choice the cap actually shortened. This is the
+        #: SLO-aware half of speculation: acceptance TUNES k; burn
+        #: SHEDS it — draft work is the one load the engine can drop
+        #: without dropping requests.
+        self.k_cap_fn = None
+        self.on_k_shed = None
 
     def choose(self, slot: int) -> int:
         if not self.adaptive:
-            return self.max_k
-        a = float(self.ema[slot])
-        k = int(round(a / max(1e-6, 1.0 - a)))
-        return min(self.max_k, max(self.min_k, k))
+            k = self.max_k
+        else:
+            a = float(self.ema[slot])
+            k = int(round(a / max(1e-6, 1.0 - a)))
+            k = min(self.max_k, max(self.min_k, k))
+        cap = self.k_cap_fn() if self.k_cap_fn is not None else None
+        if cap is not None and cap < k:
+            if self.on_k_shed is not None:
+                self.on_k_shed(slot, k, cap)
+            return max(int(cap), 0)
+        return k
 
     def update(self, slot: int, drafted: int, accepted: int) -> None:
         if drafted <= 0:
@@ -130,6 +147,14 @@ def run_spec(batcher, requests: list) -> list[np.ndarray]:
         controller = batcher._spec_controller = AdaptiveDraftController(
             slots, cfg
         )
+    # control-plane speculation shedding (ControlPlane.attach_spec sets
+    # these batcher attributes — possibly AFTER the controller was
+    # built, so they re-sync every call; absent attributes leave the
+    # controller exactly the acceptance-EMA tuner)
+    cap_fn = getattr(batcher, "_spec_k_cap_fn", None)
+    if cap_fn is not None:
+        controller.k_cap_fn = cap_fn
+        controller.on_k_shed = getattr(batcher, "_spec_k_shed_cb", None)
     metrics = getattr(batcher, "_spec_metrics", None)
     if metrics is None and batcher._registry is not None:
         from .instruments import SpecMetrics

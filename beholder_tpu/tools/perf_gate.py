@@ -34,6 +34,13 @@ metric                      why it survives host drift                fails
 ``wire_ingest_ratio``       native-batched / python-framed wire       lower
                             throughput, interleaved passes in the
                             same session — host speed divides out
+``control_victim_ttft_
+ratio``                     controlled / uncontrolled victim p95 on   higher
+                            the SAME deterministic tenant-skew
+                            replay, interleaved — host divides out
+``control_tail_fairness_
+ratio``                     victim p95 / flood p95 under control —    higher
+                            both tenants ride the same rounds
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -123,6 +130,19 @@ NOISE_BANDS: dict[str, float] = {
     # weather moves this more than the kernel ratios (four live threads
     # per pass), hence the kernel-width band
     "wire_ingest_ratio": 0.40,
+    # controlled / uncontrolled victim p95 claim-relative latency on
+    # the tenant-skew replay (schema v11): both replays run interleaved
+    # on the same host over the SAME deterministic trace, so host speed
+    # divides out — the ratio is the fair-admission plane's protection
+    # factor. Degradation = the ratio RISING back toward 1.0 (the
+    # victim re-buried behind the flood). Tails on a small replay are
+    # noisy, hence the tail-width band
+    "control_victim_ttft_ratio": 0.75,
+    # controlled victim p95 / flooding-tenant p95 (same replay): the
+    # per-tenant tail-fairness figure — under DRR the minority tenant's
+    # tail must sit well under the flood's; degradation = the victim's
+    # tail inflating toward the flood's. Same tail-width band
+    "control_tail_fairness_ratio": 0.75,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -227,6 +247,20 @@ def _fused_verify_ratio(artifact: dict) -> float | None:
     return float(value)
 
 
+def _control_victim_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "control", "victim_ttft_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v11 artifact / control scenario not run
+    return float(value)
+
+
+def _control_tail_fairness(artifact: dict) -> float | None:
+    value = _get(artifact, "control", "tail_fairness_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v11 artifact / control scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -252,6 +286,12 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # native-batched/python-framed wire throughput: an ingest-path
     # regression shows as the ratio FALLING toward the per-message loop
     ("wire_ingest_ratio", _wire_ingest_ratio, "lower"),
+    # controlled/uncontrolled victim tail on the tenant-skew replay: a
+    # fair-admission regression shows as the ratio RISING toward 1.0
+    ("control_victim_ttft_ratio", _control_victim_ratio, "higher"),
+    # victim/flood tail under control: fairness eroding shows as the
+    # victim's tail RISING toward the flood's
+    ("control_tail_fairness_ratio", _control_tail_fairness, "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -311,6 +351,20 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "ingest_python_msgs_per_sec",
         lambda a: _get(a, "ingest", "python_msgs_per_sec"),
+    ),
+    # control-plane actuation evidence behind the fairness ratios:
+    # workload-count-dependent, reported only
+    (
+        "control_uncontrolled_fairness_ratio",
+        lambda a: _get(a, "control", "uncontrolled_fairness_ratio"),
+    ),
+    (
+        "control_k_shed_events",
+        lambda a: _get(a, "control", "k_shed_events"),
+    ),
+    (
+        "control_scale_events",
+        lambda a: _get(a, "control", "scale_events"),
     ),
 ]
 

@@ -275,6 +275,67 @@ def test_caching_storage_not_found_never_cached():
     assert db.get_by_id("ghost").id == "ghost"
 
 
+class _CountingStorage(MemoryStorage):
+    """MemoryStorage that counts the BATCH hops — the evidence that
+    CachingStorage forwards them instead of unfolding per-row."""
+
+    def __init__(self):
+        super().__init__()
+        self.batch_writes = 0
+        self.batch_reads: list[list[str]] = []
+
+    def update_status_batch(self, updates):
+        self.batch_writes += 1
+        return super().update_status_batch(updates)
+
+    def get_by_ids(self, media_ids):
+        self.batch_reads.append(list(media_ids))
+        return super().get_by_ids(media_ids)
+
+
+def test_caching_storage_forwards_batch_write_and_invalidates():
+    inner = _CountingStorage()
+    db = CachingStorage(inner)
+    for i in range(3):
+        db.add_media(_media(id=f"m{i}", status=0))
+        db.get_by_id(f"m{i}")  # warm the cache with status 0
+    found = db.update_status_batch(
+        [("m0", 3), ("m1", 4), ("ghost", 5), ("m2", 6)]
+    )
+    # ONE backend transaction, per-row found flags identical to the
+    # per-message loop's outcomes
+    assert inner.batch_writes == 1
+    assert found == [True, True, False, True]
+    # write-through invalidation: the warmed rows re-read the WRITE,
+    # not the cached status-0 value
+    assert [db.get_by_id(f"m{i}").status for i in range(3)] == [3, 4, 6]
+
+
+def test_caching_storage_batch_read_serves_hits_and_folds_misses():
+    inner = _CountingStorage()
+    db = CachingStorage(inner)
+    for i in range(4):
+        db.add_media(_media(id=f"m{i}", status=i))
+    db.get_by_id("m0")  # warm one row
+    rows = db.get_by_ids(["m0", "m1", "m2", "ghost"])
+    # the cached row never hit the backend; every MISS (the unknown
+    # ghost included — absence is not knowable from the cache) went in
+    # ONE get_by_ids round trip, and missing ids are simply absent
+    assert inner.batch_reads == [["m1", "m2", "ghost"]]
+    assert sorted(rows) == ["m0", "m1", "m2"]
+    assert rows["m2"].status == 2
+    # fetched rows POPULATED the cache: a re-read is all hits
+    assert db.get_by_ids(["m1", "m2"]) and inner.batch_reads == [
+        ["m1", "m2", "ghost"]
+    ]
+    # defensive copies both ways: caller mutation must not poison
+    rows["m1"].status = 99
+    assert db.get_by_id("m1").status == 1
+    # a miss is never cached as absent: the row appears once inserted
+    db.add_media(_media(id="ghost", status=7))
+    assert db.get_by_ids(["ghost"])["ghost"].status == 7
+
+
 # -- clients: the outbound lookup cache --------------------------------------
 
 

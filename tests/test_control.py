@@ -1,0 +1,1044 @@
+"""The SLO-acting control plane: tenant-fair DRR admission (weights
+honored within one deficit; a flooding tenant cannot starve the
+others), quotas + preemption with explicit outcomes, burn-driven
+k-shedding, deadline/tail-aware routing, the autoscaler's spawn +
+byte-identical drain scale-down, the replay harness, the v11 artifact
+block, the perf-gate bands, and the default-OFF byte-identical pin."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.control import (
+    AutoscaleConfig,
+    ControlConfig,
+    RoutingConfig,
+    SpecShedConfig,
+    TenantPolicy,
+    control_from_config,
+)
+from beholder_tpu.control.admission import (
+    SHED_TENANT_PREEMPTED,
+    SHED_TENANT_QUOTA,
+    Preempted,
+    TenantFairQueue,
+)
+from beholder_tpu.control.policy import ControlPlane
+from beholder_tpu.control.replay import (
+    SCENARIOS,
+    fold_tenant_latency,
+    make_request,
+    replay,
+    tenant_skew,
+)
+from beholder_tpu.metrics import Metrics, Registry
+from beholder_tpu.obs import FlightRecorder, SLOConfig, SLOTracker
+from beholder_tpu.reliability.shed import IntakeQueue
+
+pytestmark = pytest.mark.control
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state(prefix=16):
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(
+        jax.random.PRNGKey(0), prefix, model=model
+    )
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+BATCHER_KW = dict(
+    num_pages=64, page_size=8, slots=2, max_prefix=16,
+    max_pages_per_seq=8,
+)
+
+
+def _mk_batcher(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ContinuousBatcher(model, state.params, **kw)
+
+
+class _Item:
+    """A bare tenanted intake item for queue-level tests."""
+
+    def __init__(self, tenant, tag=0):
+        self.tenant = tenant
+        self.tag = tag
+
+    def __repr__(self):
+        return f"_Item({self.tenant},{self.tag})"
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_control_config_validation():
+    with pytest.raises(ValueError):
+        TenantPolicy(weight=0.0)
+    with pytest.raises(ValueError):
+        TenantPolicy(quota=0)
+    with pytest.raises(ValueError):
+        SpecShedConfig(burn_threshold=0.0)
+    with pytest.raises(ValueError):
+        RoutingConfig(tail_threshold=1.0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_shards=2, max_shards=1)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(down_burn=2.0, up_burn=2.0)  # no hysteresis
+    with pytest.raises(ValueError):
+        AutoscaleConfig(down_pressure=0.9, up_pressure=0.5)
+
+
+def test_control_from_config_disabled_and_full_parse():
+    assert control_from_config(ConfigNode({})) is None
+    assert control_from_config(ConfigNode(
+        {"instance": {"control": {"enabled": False}}}
+    )) is None
+    cfg = control_from_config(ConfigNode({"instance": {"control": {
+        "enabled": True,
+        "tenants": {
+            "premium": {"weight": 4.0, "quota": 32},
+            "batch": {"weight": 1.0},
+        },
+        "default_weight": 2.0,
+        "default_quota": 8,
+        "spec": {"enabled": True, "burn_threshold": 3.0, "shed_to": 1},
+        "routing": {
+            "enabled": True, "tail_threshold": 2.5,
+            "deadline_slack_s": 0.5,
+        },
+        "autoscale": {
+            "enabled": True, "min_shards": 1, "max_shards": 3,
+            "up_burn": 1.5, "up_pressure": 0.6,
+            "down_burn": 0.2, "down_pressure": 0.1,
+            "sustain_s": 5, "cooldown_s": 20,
+        },
+    }}}))
+    assert cfg.tenants["premium"].weight == 4.0
+    assert cfg.tenants["premium"].quota == 32
+    assert cfg.tenants["batch"].quota is None
+    assert cfg.default_weight == 2.0 and cfg.default_quota == 8
+    assert cfg.spec.burn_threshold == 3.0 and cfg.spec.shed_to == 1
+    assert cfg.routing.tail_threshold == 2.5
+    assert cfg.autoscale.max_shards == 3
+    # unconfigured tenant falls back to the defaults
+    assert cfg.policy_for("nobody").weight == 2.0
+    assert cfg.policy_for("nobody").quota == 8
+
+
+# -- tenant-fair admission: DRR, quotas, preemption --------------------------
+
+
+def test_drr_weights_honored_within_one_deficit():
+    q = TenantFairQueue(32, ControlConfig(tenants={
+        "a": TenantPolicy(weight=2.0), "b": TenantPolicy(weight=1.0),
+    }))
+    for i in range(6):
+        assert q.offer(_Item("a", i)).accepted
+    for i in range(3):
+        assert q.offer(_Item("b", i)).accepted
+    items, waits, stamps = q.drain_all()
+    order = [item.tenant for item in items]
+    # weight 2:1 at equal unit cost: every cycle drains two of a per
+    # one of b — never more than weight+1 of a tenant consecutively
+    assert order == ["a", "a", "b", "a", "a", "b", "a", "a", "b"]
+    # FIFO holds WITHIN each tenant
+    assert [i.tag for i in items if i.tenant == "a"] == list(range(6))
+    assert [i.tag for i in items if i.tenant == "b"] == list(range(3))
+    # waits/stamps stayed item-parallel through the reorder
+    assert len(waits) == len(stamps) == 9
+
+
+def test_skewed_tenant_cannot_starve_others():
+    q = TenantFairQueue(64, ControlConfig())
+    for i in range(20):
+        assert q.offer(_Item("flood", i)).accepted
+    for i in range(2):
+        assert q.offer(_Item("victim", i)).accepted
+    items, _, _ = q.drain_all()
+    order = [item.tenant for item in items]
+    # equal weights: service ALTERNATES until the victim empties — its
+    # two requests land in the first four positions, not behind the
+    # 20-deep flood
+    assert "victim" in order[:2] and order[:4].count("victim") == 2
+    assert len(items) == 22
+
+
+def test_tenant_quota_sheds_and_counts_per_tenant():
+    reg = Registry()
+    from beholder_tpu.control.instruments import ControlMetrics
+
+    cm = ControlMetrics(reg)
+    q = TenantFairQueue(
+        32,
+        ControlConfig(tenants={"a": TenantPolicy(quota=2)}),
+        control_metrics=cm,
+    )
+    assert q.offer(_Item("a")).accepted
+    assert q.offer(_Item("a")).accepted
+    shed = q.offer(_Item("a"))
+    assert not shed.accepted and shed.reason == SHED_TENANT_QUOTA
+    assert q.offer(_Item("b")).accepted  # other tenants unaffected
+    text = reg.render()
+    assert (
+        'beholder_control_shed_total{tenant="a",reason="tenant_quota"} 1'
+        in text
+    )
+    assert 'beholder_control_admitted_total{tenant="a"} 2' in text
+    assert 'beholder_control_admitted_total{tenant="b"} 1' in text
+
+
+def test_pressure_preempts_over_share_tenant_not_newcomer():
+    preempted = []
+    q = TenantFairQueue(
+        4, ControlConfig(),
+        on_preempt=lambda item, tenant: preempted.append(
+            (item.tag, tenant)
+        ),
+    )
+    for i in range(4):
+        assert q.offer(_Item("flood", i)).accepted
+    # the queue is full, but the newcomer is the UNDER-share tenant:
+    # the flood's NEWEST item is preempted, the victim admitted
+    assert q.offer(_Item("victim", 0)).accepted
+    assert preempted == [(3, "flood")]
+    assert q.shed_counts == {SHED_TENANT_PREEMPTED: 1}
+    assert [(i.tenant, i.tag) for i in q._pending] == [
+        ("flood", 0), ("flood", 1), ("flood", 2), ("victim", 0),
+    ]
+    # with an on_preempt callback the EMBEDDER owns resolution — the
+    # queue must not also retain the victim (double-emission/leak)
+    assert q.take_preempted() == []
+    # an equally-loaded peer is never preempted: the flood's own
+    # re-offer (and a same-share newcomer) shed as the base queue would
+    assert q.offer(_Item("flood", 9)).reason == "queue_full"
+    # WITHOUT a callback the victims are retained for take_preempted
+    # (the single-engine run_pending path)
+    q2 = TenantFairQueue(2, ControlConfig())
+    assert q2.offer(_Item("flood", 0)).accepted
+    assert q2.offer(_Item("flood", 1)).accepted
+    assert q2.offer(_Item("victim", 0)).accepted
+    taken = q2.take_preempted()
+    assert len(taken) == 1 and taken[0][1] == "flood"
+    assert q2.take_preempted() == []  # drained
+
+
+def test_preemption_is_transactional_never_destroys_without_admitting():
+    """Review pin: an offer that would STILL shed after evicting every
+    eligible victim must not evict anyone — preemption only commits
+    when it actually admits the newcomer."""
+    preempted = []
+    q = TenantFairQueue(
+        32, ControlConfig(),
+        max_cost=8.0, cost_fn=lambda item: float(item.tag),
+        on_preempt=lambda item, tenant: preempted.append(item),
+    )
+    assert q.offer(_Item("a", 1)).accepted
+    assert q.offer(_Item("a", 1)).accepted
+    # b's cost-8 offer cannot fit even after taking a's one eligible
+    # victim (a's share would drop to b's prospective share): shed,
+    # and a's queued work is UNTOUCHED
+    shed = q.offer(_Item("b", 8))
+    assert not shed.accepted and shed.reason == "cost_backlog"
+    assert preempted == [] and q.take_preempted() == []
+    assert len(q._pending) == 2 and q.pending_cost == 2.0
+    # multi-victim preemption still works when it DOES admit: a third
+    # a item, then b's cost-7 offer evicts two a items and fits
+    assert q.offer(_Item("a", 1)).accepted
+    assert q.offer(_Item("b", 7)).accepted
+    assert len(preempted) == 2
+    assert all(i.tenant == "a" for i in preempted)
+    assert q.pending_cost == 8.0
+
+
+def test_restock_round_trip_preserves_stamps():
+    clock = [100.0]
+    q = TenantFairQueue(
+        8, ControlConfig(), clock=lambda: clock[0],
+    )
+    q.offer(_Item("a", 0))
+    clock[0] = 105.0
+    q.offer(_Item("b", 0))
+    clock[0] = 110.0
+    items, _, stamps = q.drain_all(record_waits=False)
+    q.restock(items, enqueued_at=stamps)
+    clock[0] = 120.0
+    _, waits, _ = q.drain_all()
+    # the eventual claiming drain still measures the FULL queue wait
+    assert waits == [20.0, 15.0]
+
+
+# -- run_pending: preempted requests resolve explicitly ----------------------
+
+
+def test_single_engine_run_pending_appends_preempted_outcomes(
+    model_state,
+):
+    model, state = model_state
+    b = _mk_batcher(model, state)
+    plane = ControlPlane(ControlConfig())
+    b.intake = plane.intake(2, cost_fn=b._need_pages)
+    assert b.submit(make_request(1, 8, 4, tenant="flood")).accepted
+    assert b.submit(make_request(2, 8, 4, tenant="flood")).accepted
+    assert b.submit(make_request(3, 8, 4, tenant="victim")).accepted
+    out = b.run_pending(waves=False)
+    served = [r for r in out if isinstance(r, np.ndarray)]
+    preempted = [r for r in out if isinstance(r, Preempted)]
+    assert len(served) == 2 and len(preempted) == 1
+    assert preempted[0].tenant == "flood"
+    assert preempted[0].outcome == "preempted"
+    assert b.intake.take_preempted() == []  # consumed, never re-emitted
+
+
+def test_replay_outcome_attribution_never_leans_on_position(
+    model_state,
+):
+    """Review pin: single-engine results come back in DRR claim order
+    with preempted outcomes appended — the replay report attributes
+    explicit outcomes by the outcome's OWN tenant, never by zip
+    position, so a preempted flood request cannot book the victim's
+    served result (or vice versa)."""
+    from beholder_tpu.control.replay import Scenario, TimedRequest
+
+    model, state = model_state
+    b = _mk_batcher(model, state)
+    plane = ControlPlane(ControlConfig())
+    b.intake = plane.intake(2, cost_fn=b._need_pages)
+    scn = Scenario("mini_preempt", [
+        TimedRequest(0, make_request(1, 8, 4, tenant="flood"), "flood"),
+        TimedRequest(0, make_request(2, 8, 4, tenant="flood"), "flood"),
+        TimedRequest(0, make_request(3, 8, 4, tenant="victim"),
+                     "victim"),
+    ])
+    report = replay(b, scn, run_pending_kwargs={"waves": False})
+    assert report.admitted == {"flood": 2, "victim": 1}
+    assert report.outcomes["flood"] == {"preempted": 1, "ok": 1}
+    assert report.outcomes["victim"] == {"ok": 1}
+
+
+def test_cluster_preempted_resolves_in_admission_order(model_state):
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    plane = ControlPlane(ControlConfig())
+    sched = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=1, max_pending_per_shard=2),
+        control_plane=plane, **BATCHER_KW,
+    )
+    assert sched.submit(make_request(1, 8, 4, tenant="flood")).accepted
+    assert sched.submit(make_request(2, 8, 4, tenant="flood")).accepted
+    assert sched.submit(make_request(3, 8, 4, tenant="victim")).accepted
+    out = sched.run_pending()
+    # admission order: the preempted FLOOD request's slot (seq 1 — its
+    # newest) carries the explicit outcome; everyone else served
+    assert len(out) == 3
+    assert isinstance(out[0], np.ndarray)
+    assert isinstance(out[1], Preempted) and out[1].tenant == "flood"
+    assert isinstance(out[2], np.ndarray)
+    # the preemption released the shard reservation: pool settles empty
+    assert sched.shards[0].pool.committed == 0
+    # with on_preempt wired (the router path) the queue does NOT also
+    # retain the victim — retention would leak on a long-lived router
+    # and re-emit a duplicate outcome through the shard batcher's own
+    # run_pending (review pin)
+    assert sched.shards[0].intake.take_preempted() == []
+
+
+def test_cluster_preemption_visible_to_tenant_burn(model_state):
+    """Review pin: a queued request preempted BEFORE it ever claimed
+    has no open SLO entry — the req.dropped instant itself must carry
+    the tenant, or the victimized tenant's burn stays blind to exactly
+    the loss the control plane inflicted."""
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    recorder = FlightRecorder(ring_size=4096)
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=60_000.0, tpot_ms=60_000.0, target=0.9)
+    )
+    recorder.add_listener(tracker.on_event)
+    plane = ControlPlane(ControlConfig(), tracker=tracker)
+    sched = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=1, max_pending_per_shard=2),
+        control_plane=plane, flight_recorder=recorder, **BATCHER_KW,
+    )
+    assert sched.submit(make_request(1, 8, 4, tenant="flood")).accepted
+    assert sched.submit(make_request(2, 8, 4, tenant="flood")).accepted
+    assert sched.submit(make_request(3, 8, 4, tenant="victim")).accepted
+    sched.run_pending()
+    stats = tracker.tenant_stats()
+    # the preempted flood request classified BAD under its own tenant
+    assert stats["flood"]["bad"] == 1
+    assert stats["flood"]["good"] == 1
+    assert stats["victim"]["good"] == 1
+
+
+def test_round_robin_policy_survives_control_with_no_override(
+    model_state,
+):
+    """Review pin: control routing must not silently replace a
+    configured round-robin policy when it has nothing to override (no
+    tail inflation, no urgent deadline)."""
+    from beholder_tpu.cluster import ROUTE_ROUND_ROBIN, ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    reg = Registry()
+    plane = ControlPlane(
+        ControlConfig(routing=RoutingConfig()),
+        tracker=SLOTracker(SLOConfig()),
+    )
+    sched = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(
+            n_decode_workers=2, route_policy=ROUTE_ROUND_ROBIN,
+        ),
+        metrics=reg, control_plane=plane, **BATCHER_KW,
+    )
+    for i in range(4):
+        assert sched.submit(make_request(i, 8, 4)).accepted
+    # round-robin alternated: two requests per shard, counted as such
+    assert sched.shards[0].intake.depth == 2
+    assert sched.shards[1].intake.depth == 2
+    assert (
+        'beholder_cluster_routes_total{reason="round_robin"} 4'
+        in reg.render()
+    )
+
+
+# -- tenant threading: claim instants, timelines, per-tenant digests ---------
+
+
+def test_tenant_threads_claims_timelines_and_tracker(model_state):
+    from beholder_tpu.obs import build_timelines
+
+    model, state = model_state
+    recorder = FlightRecorder(ring_size=4096)
+    # objectives sized for a cold CPU run (jit compile walls must not
+    # classify the request bad — this test is about THREADING)
+    tracker = SLOTracker(SLOConfig(ttft_ms=60_000.0, tpot_ms=60_000.0))
+    recorder.add_listener(tracker.on_event)
+    b = _mk_batcher(model, state, flight_recorder=recorder)
+    b.run([
+        make_request(1, 8, 4, tenant="premium"),
+        make_request(2, 8, 4, tenant="batch"),
+        make_request(3, 8, 4),  # untenanted: event shape unchanged
+    ])
+    claims = [
+        e for e in recorder.events() if e["name"] == "req.claim"
+    ]
+    tenants = [e["args"].get("tenant") for e in claims]
+    assert sorted(t for t in tenants if t) == ["batch", "premium"]
+    assert any("tenant" not in e["args"] for e in claims)
+    report = build_timelines(recorder.events())
+    by_tenant = {t.tenant for t in report.timelines}
+    assert {"premium", "batch", None} <= by_tenant
+    stats = tracker.tenant_stats()
+    assert set(stats) == {"batch", "premium"}
+    assert stats["premium"]["good"] == 1
+    assert stats["premium"]["ttft_ms"]["p95"] > 0
+    # the snapshot carries the tenants block; untenanted traffic never
+    # fabricates one
+    assert set(tracker.snapshot()["tenants"]) == {"batch", "premium"}
+
+
+def test_tracker_tenant_burn_isolated_per_tenant():
+    clock = [0.0]
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=10.0, target=0.9), clock=lambda: clock[0]
+    )
+    for _ in range(10):
+        tracker.observe(5.0, tenant="bad")     # way past the objective
+        tracker.observe(0.001, tenant="good")  # comfortably inside
+    assert tracker.tenant_burn("bad") == pytest.approx(10.0)
+    assert tracker.tenant_burn("good") == 0.0
+    assert tracker.tenant_burn("never-seen") == 0.0
+
+
+# -- SLO-aware speculation: k sheds under burn -------------------------------
+
+
+def test_spec_k_sheds_under_burn_and_restores(model_state):
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    clock = [0.0]
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=10.0, target=0.9, fast_window_s=30.0),
+        clock=lambda: clock[0],
+    )
+    plane = ControlPlane(
+        ControlConfig(spec=SpecShedConfig(burn_threshold=2.0, shed_to=0)),
+        tracker=tracker,
+    )
+    reg = Registry()
+    plane_metrics = ControlPlane(
+        ControlConfig(spec=SpecShedConfig(burn_threshold=2.0, shed_to=0)),
+        tracker=tracker, registry=reg,
+    )
+    b = _mk_batcher(model, state, spec=SpecConfig(max_draft=3))
+    plane_metrics.attach_spec(b)
+    capped = b.run_spec([make_request(1, 8, 6)])
+    controller = b._spec_controller
+    assert plane_metrics.k_shed_events == 0  # healthy: untouched
+    for _ in range(20):
+        tracker.observe(5.0)  # inject fast-window burn
+    assert tracker.burn_rate("fast") > 2.0
+    capped = b.run_spec([make_request(2, 8, 6)])
+    assert plane_metrics.k_shed_events > 0
+    assert controller.choose(0) == 0  # draft length shed to zero
+    assert "beholder_control_k_shed_total" in reg.render()
+    # the burn window drains: the cap lifts, tuning resumes
+    clock[0] += 60.0
+    tracker.observe(0.001)
+    assert controller.choose(0) >= 1
+    # bitwise: exact-greedy spec output is k-independent, so shedding
+    # draft work never changed a served token
+    ref = _mk_batcher(model, state, spec=SpecConfig(max_draft=3))
+    expect = ref.run_spec([make_request(2, 8, 6)])
+    assert all(
+        np.array_equal(a, r) for a, r in zip(capped, expect)
+    )
+    assert plane.k_shed_events == 0  # the unattached plane never acted
+
+
+# -- routing: tail avoidance + deadline slack --------------------------------
+
+
+class _StubPool:
+    def __init__(self, shard_id, free):
+        self.shard_id = shard_id
+        self.name = f"decode-{shard_id}"
+        self.free = free
+
+
+class _StubIntake:
+    def __init__(self, depth):
+        self.depth = depth
+
+
+class _StubShard:
+    def __init__(self, shard_id, free, depth=0):
+        self.pool = _StubPool(shard_id, free)
+        self.intake = _StubIntake(depth)
+
+
+def test_routing_avoids_tail_inflated_shard():
+    tracker = SLOTracker(SLOConfig(ttft_ms=30000.0))
+    plane = ControlPlane(
+        ControlConfig(routing=RoutingConfig(tail_threshold=3.0)),
+        tracker=tracker,
+    )
+    # decode-0: tail detached from median (p95 >> p50); decode-1 calm
+    for _ in range(20):
+        tracker.observe(0.010, worker="decode-0")
+        tracker.observe(0.010, worker="decode-1")
+    for _ in range(5):
+        tracker.observe(2.0, worker="decode-0")
+        tracker.observe(0.012, worker="decode-1")
+    assert tracker.scope_tail_ratio("decode-0") > 3.0
+    assert tracker.scope_tail_ratio("decode-1") < 3.0
+    # decode-0 shows MORE free pages, yet the policy avoids it
+    shards = [_StubShard(0, free=60), _StubShard(1, free=40)]
+    shard, reason = plane.route_shard(shards, need=2)
+    assert shard.pool.shard_id == 1 and reason == "tail_avoid"
+    # with every shard inflated, pressure wins again (no dead ends)
+    for _ in range(5):
+        tracker.observe(2.0, worker="decode-1")
+    shard, reason = plane.route_shard(shards, need=2)
+    assert shard.pool.shard_id == 0 and reason == "pressure"
+
+
+def test_routing_deadline_slack_prefers_shallow_queue():
+    from beholder_tpu.models.serving import Request
+    from beholder_tpu.reliability.policy import Deadline
+
+    plane = ControlPlane(ControlConfig(routing=RoutingConfig(
+        tail_threshold=3.0, deadline_slack_s=1.0,
+    )))
+    # shard 0: emptier pool but deeper queue; shard 1: shallow queue
+    shards = [_StubShard(0, free=60, depth=5), _StubShard(1, free=40)]
+    relaxed = Request(
+        np.zeros(3), np.zeros(3, np.int64), 4,
+        deadline=Deadline.after(100.0),
+    )
+    shard, reason = plane.route_shard(shards, 2, relaxed)
+    assert shard.pool.shard_id == 0 and reason == "pressure"
+    urgent = relaxed._replace(deadline=Deadline.after(0.2))
+    shard, reason = plane.route_shard(shards, 2, urgent)
+    assert shard.pool.shard_id == 1 and reason == "deadline"
+
+
+def test_cluster_route_counter_carries_control_reasons(model_state):
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.models.serving import Request
+    from beholder_tpu.reliability.policy import Deadline
+
+    model, state = model_state
+    reg = Registry()
+    plane = ControlPlane(
+        ControlConfig(routing=RoutingConfig(deadline_slack_s=1.0)),
+        registry=reg,
+    )
+    sched = ClusterScheduler(
+        model, state.params, ClusterConfig(n_decode_workers=2),
+        metrics=reg, control_plane=plane, **BATCHER_KW,
+    )
+    rng = np.random.default_rng(5)
+    urgent = Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, 9)),
+        np.full(9, 2), 4, deadline=Deadline.after(0.2),
+    )
+    # depth-skew the shards so the deadline term has a preference
+    sched.shards[0].intake.offer((99, make_request(50, 8, 4)))
+    assert sched.submit(urgent).accepted
+    text = reg.render()
+    assert (
+        'beholder_cluster_routes_total{reason="control_deadline"} 1'
+        in text
+    )
+    assert (
+        'beholder_control_route_overrides_total{reason="deadline"} 1'
+        in text
+    )
+
+
+# -- the autoscaler actuator -------------------------------------------------
+
+
+def _scaling_fixture(model, state, n_shards=1, **auto_kw):
+    from beholder_tpu.cluster import ClusterConfig, FailoverConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    clock = [0.0]
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=10.0, target=0.9, fast_window_s=30.0),
+        clock=lambda: clock[0],
+    )
+    kw = dict(
+        min_shards=1, max_shards=2, up_burn=1.0, up_pressure=0.3,
+        down_burn=0.5, down_pressure=0.2, sustain_s=1.0,
+        cooldown_s=0.0,
+    )
+    kw.update(auto_kw)
+    plane = ControlPlane(
+        ControlConfig(autoscale=AutoscaleConfig(**kw)),
+        tracker=tracker, clock=lambda: clock[0],
+    )
+    sched = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(
+            n_decode_workers=n_shards, failover=FailoverConfig(),
+        ),
+        control_plane=plane,
+        num_pages=16, page_size=8, slots=2, max_prefix=16,
+        max_pages_per_seq=8,
+    )
+    return sched, plane, tracker, clock
+
+
+def test_autoscaler_spawns_under_sustained_burn_and_pressure(
+    model_state,
+):
+    model, state = model_state
+    sched, plane, tracker, clock = _scaling_fixture(model, state)
+    for _ in range(10):
+        tracker.observe(5.0)  # burning
+    for i in range(4):
+        sched.submit(make_request(i, 8, 4))  # pool pressure
+    assert plane.evaluate_scaling(sched) is None  # arms the window
+    clock[0] += 0.5
+    assert plane.evaluate_scaling(sched) is None  # not yet sustained
+    clock[0] += 1.0
+    event = plane.evaluate_scaling(sched)
+    assert event is not None and event["direction"] == "up"
+    assert len(sched.shards) == 2
+    # bounded: already at max_shards — no further spawn
+    clock[0] += 5.0
+    assert plane.evaluate_scaling(sched) is None
+    clock[0] += 5.0
+    assert plane.evaluate_scaling(sched) is None
+    assert len(sched.shards) == 2
+    # the spawned shard serves: the queued work drains across both
+    out = sched.run_pending()
+    assert len(out) == 4 and all(
+        isinstance(r, np.ndarray) for r in out
+    )
+
+
+def test_autoscaler_cooldown_spaces_actuations(model_state):
+    model, state = model_state
+    sched, plane, tracker, clock = _scaling_fixture(
+        model, state, cooldown_s=30.0, max_shards=3,
+    )
+    for _ in range(10):
+        tracker.observe(5.0)
+    for i in range(4):
+        sched.submit(make_request(i, 8, 4))
+    plane.evaluate_scaling(sched)
+    clock[0] += 2.0
+    assert plane.evaluate_scaling(sched)["direction"] == "up"
+    # conditions still hold, but cooldown blocks the next actuation
+    clock[0] += 2.0
+    plane.evaluate_scaling(sched)
+    clock[0] += 2.0
+    assert plane.evaluate_scaling(sched) is None
+    assert len(sched.shards) == 2
+
+
+def test_scale_down_drains_losslessly_bitwise(model_state):
+    """The acceptance pin: the scale-down actuator reuses PR 8's
+    byte-identical drain() — queued work migrates and serves with
+    streams bitwise-identical to a single uninterrupted engine."""
+    model, state = model_state
+    sched, plane, tracker, clock = _scaling_fixture(
+        model, state, n_shards=2,
+    )
+    requests = [make_request(100 + i, 8, 6) for i in range(4)]
+    for req in requests:
+        assert sched.submit(req).accepted
+    # calm: burn 0, pressure released at... pressure = committed/total
+    # still > 0 from the queued reservations — the DOWN condition needs
+    # pressure BELOW the watermark, so evaluate AFTER serving
+    tracker.observe(0.001)
+    plane.evaluate_scaling(sched)  # queued pressure: no actuation yet
+    out_before = sched.run_pending()
+    clock[0] += 2.0
+    plane.evaluate_scaling(sched)  # arms the down window (calm now)
+    clock[0] += 2.0
+    event = plane.evaluate_scaling(sched)
+    assert event is not None and event["direction"] == "down"
+    assert sched.failover.drains == 1
+    # capacity is gone but nothing was lost; the survivor still serves
+    requests2 = [make_request(200 + i, 8, 6) for i in range(3)]
+    for req in requests2:
+        assert sched.submit(req).accepted
+    out_after = sched.run_pending()
+    # bitwise: the whole scaled stream equals one uninterrupted
+    # single-device engine over the same requests
+    ref = _mk_batcher(
+        model, state, num_pages=16, max_pages_per_seq=8, intake=None,
+    )
+    expect = [ref.run([r])[0] for r in requests + requests2]
+    got = out_before + out_after
+    assert len(got) == len(expect)
+    assert all(np.array_equal(g, e) for g, e in zip(got, expect))
+    # min_shards floor: the survivor is never drained
+    clock[0] += 5.0
+    plane.evaluate_scaling(sched)
+    clock[0] += 5.0
+    assert plane.evaluate_scaling(sched) is None
+    assert sched.failover.drains == 1
+
+
+def test_scale_up_shard_is_boot_identical(model_state):
+    from beholder_tpu.cluster import ClusterConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    model, state = model_state
+    reg = Registry()
+    sched = ClusterScheduler(
+        model, state.params, ClusterConfig(n_decode_workers=1),
+        metrics=reg, **BATCHER_KW,
+    )
+    shard = sched.scale_up()
+    assert shard.pool.name == "decode-1"
+    assert len(sched.shards) == 2
+    assert sched.pool_view.total_pages == 2 * BATCHER_KW["num_pages"]
+    # the spawned shard's stream is bitwise the single engine's
+    req = make_request(7, 8, 6)
+    got = shard.batcher.run([req])[0]
+    expect = _mk_batcher(model, state).run([req])[0]
+    assert np.array_equal(got, expect)
+    assert 'beholder_cluster_shards 2' in reg.render()
+
+
+# -- the replay harness ------------------------------------------------------
+
+
+def test_scenarios_are_deterministic():
+    for name, build in SCENARIOS.items():
+        a, b = build(), build()
+        assert a.name == name
+        assert len(a.arrivals) == len(b.arrivals) > 0
+        for x, y in zip(a.arrivals, b.arrivals):
+            assert x.burst == y.burst and x.tenant == y.tenant
+            assert np.array_equal(x.request.progress, y.request.progress)
+
+
+def test_shared_prefix_storm_shares_prefixes():
+    from beholder_tpu.control.replay import shared_prefix_storm
+
+    scn = shared_prefix_storm(n=4)
+    first = scn.arrivals[0].request.progress
+    assert all(
+        np.array_equal(a.request.progress, first) for a in scn.arrivals
+    )
+
+
+def test_replay_drr_protects_victim_tenant(model_state):
+    """The headline fairness replay: under FIFO the victim's requests
+    sit behind the flood; under DRR they claim near the front — the
+    victim's p95 claim-relative latency improves STRUCTURALLY (the
+    bench commits the ratio; this pins its sign)."""
+    model, state = model_state
+    scn = tenant_skew(heavy_n=10, victim_n=2, prefix_t=8, horizon=8)
+
+    def run_pass(fair):
+        ring = FlightRecorder(ring_size=8192)
+        b = _mk_batcher(model, state, flight_recorder=ring)
+        if fair:
+            plane = ControlPlane(ControlConfig(tenants={
+                "victim": TenantPolicy(weight=4.0),
+            }))
+            b.intake = plane.intake(64, cost_fn=b._need_pages)
+        else:
+            b.intake = IntakeQueue(64, cost_fn=b._need_pages)
+        for arrival in scn.arrivals[:4]:
+            b.submit(arrival.request)
+        b.run_pending(waves=False)  # warm the jits
+        ring.clear()
+        return replay(
+            b, scn, recorder=ring,
+            run_pending_kwargs={"waves": False},
+        )
+
+    fifo = run_pass(fair=False)
+    fair = run_pass(fair=True)
+    assert fifo.admitted == fair.admitted == {"flood": 10, "victim": 2}
+    assert fifo.tenant_latency["victim"]["count"] == 2
+    ratio_fifo = fifo.fairness_ratio("victim", "flood")
+    ratio_fair = fair.fairness_ratio("victim", "flood")
+    assert ratio_fifo is not None and ratio_fair is not None
+    # FIFO buries the victim at the tail (ratio ~>= 1); DRR serves it
+    # near the front (ratio well under 1) — the sign is structural
+    assert ratio_fair < ratio_fifo
+    assert fair.tenant_p95_ms("victim") < fifo.tenant_p95_ms("victim")
+
+
+def test_replay_recovery_storm_with_injected_kill(model_state):
+    from beholder_tpu.cluster import ClusterConfig, FailoverConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.control.replay import recovery_storm
+    from beholder_tpu.reliability.chaos import WorkerFault
+
+    model, state = model_state
+    sched = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(n_decode_workers=2, failover=FailoverConfig()),
+        **BATCHER_KW,
+    )
+    # kill decode-0 after its first tick dispatch: the storm's
+    # requests recover onto the survivor mid-replay. Six requests over
+    # two 2-slot shards = two admission rounds per shard, so the
+    # faulted shard's SECOND tick-chunk dispatch genuinely fires
+    sched.failover.inject_fault(
+        WorkerFault("decode-0", kind="kill", after_dispatches=1)
+    )
+    scn = recovery_storm(n=6, prefix_t=8, horizon=6)
+    report = replay(sched, scn)
+    assert report.outcomes["storm"]["ok"] == 6
+    assert sched.failover.recovered_total > 0
+    # bitwise through the recovery, per the failover contract
+    expect = [
+        _mk_batcher(model, state).run([a.request])[0]
+        for a in scn.arrivals
+    ]
+    assert all(
+        np.array_equal(g, e) for g, e in zip(report.results, expect)
+    )
+
+
+def test_fold_tenant_latency_orders_by_claim(model_state):
+    model, state = model_state
+    ring = FlightRecorder(ring_size=4096)
+    b = _mk_batcher(model, state, flight_recorder=ring, slots=1)
+    b.run([
+        make_request(1, 8, 6, tenant="first"),
+        make_request(2, 8, 6, tenant="second"),
+    ])
+    folded = fold_tenant_latency(ring.events())
+    # slots=1 serializes: the second tenant's claim-relative latency
+    # strictly contains the first's whole service
+    assert folded["second"]["p95_ms"] > folded["first"]["p95_ms"]
+
+
+# -- surfaces: /control, metrics catalog -------------------------------------
+
+
+def test_control_route_serves_policy_state():
+    plane = ControlPlane(
+        ControlConfig(
+            tenants={"premium": TenantPolicy(weight=4.0, quota=32)},
+            spec=SpecShedConfig(),
+        ),
+        tracker=SLOTracker(SLOConfig()),
+    )
+    metrics = Metrics()
+    port = metrics.expose(0)
+    try:
+        metrics.add_route("/control", plane.http_route())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/control"
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["policy"]["tenants"]["premium"]["weight"] == 4.0
+        assert body["policy"]["spec"]["burn_threshold"] == 2.0
+        assert body["policy"]["autoscale"] is None
+        assert body["k_shed_events"] == 0
+        assert "burn_rate" in body and "tenants" in body
+        # the exposition itself is untouched by the extra route
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            assert resp.read().decode() == metrics.registry.render()
+    finally:
+        metrics.close()
+
+
+def test_policy_exported_as_gauges():
+    reg = Registry()
+    ControlPlane(
+        ControlConfig(
+            tenants={"premium": TenantPolicy(weight=4.0, quota=32)},
+            default_quota=8,
+        ),
+        registry=reg,
+    )
+    text = reg.render()
+    assert 'beholder_control_tenant_weight{tenant="premium"} 4' in text
+    assert 'beholder_control_tenant_quota{tenant="premium"} 32' in text
+    assert 'beholder_control_tenant_quota{tenant="default"} 8' in text
+    assert 'beholder_control_k_cap -1' in text
+
+
+# -- default OFF: byte-identical serving + exposition ------------------------
+
+
+def test_control_off_serving_and_exposition_byte_identical(model_state):
+    """The house contract: with no control plane, the default
+    exposition carries no beholder_control_* series, a service parse
+    without the knob yields None, and a TenantFairQueue-free engine
+    serves streams bitwise-identical to pre-control code (trivially —
+    nothing control-flavored is on any default path)."""
+    model, state = model_state
+    assert "beholder_control" not in Metrics().registry.render()
+    assert control_from_config(ConfigNode({"instance": {}})) is None
+    # an armed-but-single-tenant fair queue changes NOTHING about the
+    # served streams either: DRR over one tenant is FIFO
+    plain = _mk_batcher(model, state)
+    plain.intake = IntakeQueue(16, cost_fn=plain._need_pages)
+    fair = _mk_batcher(model, state)
+    fair.intake = ControlPlane(ControlConfig()).intake(
+        16, cost_fn=fair._need_pages
+    )
+    requests = [make_request(i, 8, 5) for i in range(5)]
+    for req in requests:
+        assert plain.submit(req).accepted
+        assert fair.submit(req).accepted
+    out_plain = plain.run_pending(waves=False)
+    out_fair = fair.run_pending(waves=False)
+    assert len(out_plain) == len(out_fair) == 5
+    assert all(
+        np.array_equal(a, b) for a, b in zip(out_plain, out_fair)
+    )
+
+
+def test_service_control_route_absent_by_default():
+    from beholder_tpu.metrics import serve_routes  # noqa: F401
+
+    metrics = Metrics()
+    port = metrics.expose(0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/control")
+        assert err.value.code == 404
+    finally:
+        metrics.close()
+
+
+# -- artifact v11 + perf gate ------------------------------------------------
+
+
+def test_artifact_v11_control_block_roundtrip(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_test")
+    rec.record_control({
+        "victim_ttft_ratio": 0.21,
+        "tail_fairness_ratio": 0.20,
+        "uncontrolled_fairness_ratio": 1.19,
+        "admitted_by_tenant": {"flood": 12, "victim": 2},
+        "shed_by_tenant": {},
+        "k_shed_events": 9.0,
+        "scale_events": 2.0,
+    })
+    path = rec.write(str(tmp_path / "a.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema_version"] == 11
+    assert obj["control"]["victim_ttft_ratio"] == 0.21
+    assert obj["control"]["admitted_by_tenant"]["flood"] == 12
+    with pytest.raises(ValueError, match="control summary missing"):
+        rec.record_control({"victim_ttft_ratio": 1.0})
+    # malformed block fails validation
+    bad = json.loads((tmp_path / "a.json").read_text())
+    bad["control"]["k_shed_events"] = "nine"
+    with pytest.raises(ValueError, match="control.k_shed_events"):
+        artifact.validate(bad)
+
+
+def test_perf_gate_bands_control_ratios():
+    from beholder_tpu.tools.perf_gate import run_gate
+
+    def art(victim_ratio, tail_ratio):
+        return {
+            "control": {
+                "victim_ttft_ratio": victim_ratio,
+                "tail_fairness_ratio": tail_ratio,
+            },
+        }
+
+    verdict = run_gate(art(0.2, 0.2), art(0.2, 0.2))
+    by_name = {c["metric"]: c for c in verdict["checks"]}
+    assert by_name["control_victim_ttft_ratio"]["ok"]
+    assert by_name["control_tail_fairness_ratio"]["ok"]
+    # fairness eroding: the victim ratio rising past the band fails
+    verdict = run_gate(art(0.2, 0.2), art(0.9, 0.2))
+    assert "control_victim_ttft_ratio" in verdict["failed"]
+    verdict = run_gate(art(0.2, 0.2), art(0.2, 0.9))
+    assert "control_tail_fairness_ratio" in verdict["failed"]
+    # the block absent on one side skips, never fails
+    verdict = run_gate({}, art(0.2, 0.2))
+    skipped = {s["metric"] for s in verdict["skipped"]}
+    assert "control_victim_ttft_ratio" in skipped
+    assert verdict["verdict"] == "pass"
+
+
+def test_committed_bench_control_artifact_is_live():
+    obj = artifact.validate_file("artifacts/bench_control.json")
+    assert obj["schema_version"] >= 11
+    control = obj["control"]
+    assert 0 < control["victim_ttft_ratio"] < 1.0
+    assert control["k_shed_events"] > 0
+    assert control["scale_events"] > 0
