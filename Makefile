@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight perf-gate lint clean
 
 all: proto native
 
@@ -118,6 +118,18 @@ bench-ingest: native
 # carries the same scenario inside bench_e2e.json's v11 control block)
 bench-control:
 	python bench.py --control-only
+
+# the flight-plane scenario alone: the 2-shard disaggregated cluster
+# with one injected decode-worker kill, every cross-worker hop edge-
+# tagged, the per-worker rings skew-aligned and merged into ONE
+# causally-ordered timeline (writes artifacts/bench_flightplane.json
+# plus the merged artifacts/flight/cluster_flight.{jsonl,trace.json} —
+# the trace renders transfer/restock/recovery flow arrows in Perfetto;
+# same forced-mesh trick as bench-cluster so the shards sit on real
+# device boundaries)
+bench-flight:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench.py --flight-only
 
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve

@@ -66,7 +66,10 @@ Schema (``validate`` is the authoritative checker)::
                   "admitted_by_tenant": {},
                   "shed_by_tenant": {},
                   "k_shed_events": 0.0,
-                  "scale_events": 0.0}  # v11: control plane
+                  "scale_events": 0.0},  # v11: control plane
+      "flight_plane": {"workers": 0.0, "merged_events": 0.0,
+                       "flow_edges": 0.0,
+                       "max_abs_skew_us": 0.0}  # v12: flight plane
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -160,6 +163,13 @@ the per-tenant tail-fairness figure, also banded higher-fails),
 the uncontrolled ratio for the reader, per-tenant admission/shed
 attribution, and the k-shed/scale actuation counts. v1-v10 artifacts
 remain valid.
+
+Schema v12 (the flight-plane PR): the run's cluster-wide merge
+evidence rides along (:meth:`ArtifactRecorder.record_flight_plane`) —
+how many worker rings folded into the merged timeline, the merged
+event count, the matched cross-worker edge pairs (transfer/handoff/
+restock flow arrows), and the worst absolute clock skew the merge
+aligned away. v1-v11 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -171,7 +181,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -285,6 +295,16 @@ EMPTY_CONTROL = {
     "scale_events": 0.0,
 }
 
+#: v12: the flight-plane block's required shape (an empty block is
+#: valid — a run that never armed the plane still writes a v12
+#: artifact)
+EMPTY_FLIGHT_PLANE = {
+    "workers": 0.0,
+    "merged_events": 0.0,
+    "flow_edges": 0.0,
+    "max_abs_skew_us": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -369,6 +389,7 @@ class ArtifactRecorder:
         self.kernel: dict[str, Any] = copy.deepcopy(EMPTY_KERNEL)
         self.ingest: dict[str, float] = dict(EMPTY_INGEST)
         self.control: dict[str, Any] = copy.deepcopy(EMPTY_CONTROL)
+        self.flight_plane: dict[str, float] = dict(EMPTY_FLIGHT_PLANE)
 
     def section(
         self,
@@ -558,6 +579,19 @@ class ArtifactRecorder:
             {key: summary[key] for key in EMPTY_CONTROL}
         )
 
+    def record_flight_plane(self, summary: dict[str, Any]) -> None:
+        """Adopt one flight-plane merge summary
+        (:class:`beholder_tpu.obs.MergedTimeline` ``.summary``) as the
+        run's v12 ``flight_plane`` block. Last writer wins — the block
+        carries the HEADLINE merged-cluster run (ring folds don't sum
+        across scenarios)."""
+        for key in EMPTY_FLIGHT_PLANE:
+            if key not in summary:
+                raise ValueError(f"flight_plane summary missing {key!r}")
+        self.flight_plane = {
+            key: float(summary[key]) for key in EMPTY_FLIGHT_PLANE
+        }
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -606,6 +640,7 @@ class ArtifactRecorder:
             "kernel": copy.deepcopy(self.kernel),
             "ingest": dict(self.ingest),
             "control": copy.deepcopy(self.control),
+            "flight_plane": dict(self.flight_plane),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -720,6 +755,14 @@ def record_control(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_control(summary)
+
+
+def record_flight_plane(summary: dict) -> None:
+    """Adopt a flight-plane merge summary into the active recorder's
+    v12 ``flight_plane`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_flight_plane(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -914,6 +957,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"control.{key} must be a number, "
                         f"got {control.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 12:
+        # v12: flight-plane cluster-merge evidence
+        plane = obj.get("flight_plane")
+        if not isinstance(plane, dict):
+            problems.append("flight_plane must be a dict (schema v12+)")
+        else:
+            for key in EMPTY_FLIGHT_PLANE:
+                if not isinstance(plane.get(key), (int, float)):
+                    problems.append(
+                        f"flight_plane.{key} must be a number, "
+                        f"got {plane.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

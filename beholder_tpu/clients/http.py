@@ -42,6 +42,7 @@ class HttpTransport(abc.ABC):
         params: dict[str, Any] | None = None,
         json: dict[str, Any] | None = None,
         timeout: float = 10.0,
+        headers: dict[str, str] | None = None,
     ) -> HttpResponse:
         """Perform one HTTP request and return the (possibly JSON) response."""
 
@@ -49,11 +50,13 @@ class HttpTransport(abc.ABC):
 class RequestsTransport(HttpTransport):
     """Production transport backed by ``requests``."""
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
         import requests
 
         resp = requests.request(
-            method.upper(), url, params=params, json=json, timeout=timeout
+            method.upper(), url, params=params, json=json, timeout=timeout,
+            headers=headers,
         )
         try:
             body = resp.json()
@@ -96,11 +99,16 @@ class TimedTransport(HttpTransport):
             labelnames=["method", "outcome"],
         )
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
+        # headers forwarded only when set: duck-typed transports
+        # predating the headers kwarg keep working headerless
+        extra = {"headers": headers} if headers is not None else {}
         t0 = time.perf_counter()
         try:
             resp = self.inner.request(
-                method, url, params=params, json=json, timeout=timeout
+                method, url, params=params, json=json, timeout=timeout,
+                **extra,
             )
         except Exception as err:
             self._hist.observe(
@@ -113,6 +121,36 @@ class TimedTransport(HttpTransport):
             outcome=f"{resp.status // 100}xx",
         )
         return resp
+
+
+class TracingTransport(HttpTransport):
+    """Injects the active span's W3C ``traceparent`` header into every
+    outbound request — the flight plane's HTTP propagation leg, so an
+    egress call (Trello/Telegram/Emby) carries the trace the triggering
+    message opened across the process boundary. The service wires this
+    OUTERMOST, and only when ``instance.observability.flight_plane.*``
+    is armed: with the knob off no wrapper exists and outbound wire
+    bytes are byte-identical. Caller-provided headers win on conflict
+    (an explicit traceparent is an explicit parent)."""
+
+    def __init__(self, inner: HttpTransport):
+        self.inner = inner
+
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
+        from beholder_tpu.tracing import active_context, to_traceparent
+
+        ctx = active_context()
+        if ctx is not None:
+            merged = {"traceparent": to_traceparent(ctx)}
+            if headers:
+                merged.update(headers)
+            headers = merged
+        extra = {"headers": headers} if headers is not None else {}
+        return self.inner.request(
+            method, url, params=params, json=json, timeout=timeout,
+            **extra,
+        )
 
 
 def read_only_get(method: str, url: str) -> bool:
@@ -173,16 +211,24 @@ class CachingTransport(HttpTransport):
     def cache(self):
         return self._cache
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
+        # headers forwarded only when set: duck-typed transports
+        # predating the headers kwarg keep working headerless
+        extra = {"headers": headers} if headers is not None else {}
         if json is not None or not self._cacheable(method, url):
             return self.inner.request(
-                method, url, params=params, json=json, timeout=timeout
+                method, url, params=params, json=json, timeout=timeout,
+                **extra,
             )
+        # headers are deliberately NOT part of the cache key: trace
+        # context varies per request and must not shatter the cache
         key = (method.upper(), url, _freeze(params or {}))
 
         def load():
             resp = self.inner.request(
-                method, url, params=params, json=None, timeout=timeout
+                method, url, params=params, json=None, timeout=timeout,
+                **extra,
             )
             if resp.status >= 300:
                 # an error/redirect must not be replayed for ttl_s; the
@@ -229,6 +275,7 @@ class _Recorded:
     url: str
     params: dict[str, Any] | None
     json: dict[str, Any] | None
+    headers: dict[str, str] | None = None
 
 
 class RecordingTransport(HttpTransport):
@@ -239,8 +286,11 @@ class RecordingTransport(HttpTransport):
         self.responses: list[HttpResponse] = []
         self.fail_with: Exception | None = None
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
-        self.requests.append(_Recorded(method.upper(), url, params, json))
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
+        self.requests.append(
+            _Recorded(method.upper(), url, params, json, headers)
+        )
         if self.fail_with is not None:
             raise self.fail_with
         if self.responses:

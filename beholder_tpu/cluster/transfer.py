@@ -211,6 +211,17 @@ class PageTransferEngine:
         as :class:`TransferFailed`."""
         fr = self.flight_recorder
         ts = time.time() if fr is not None else 0.0
+        # edge id: None unless a flight plane is bound (the armed write
+        # side) — with one, the send instant lands on the SOURCE
+        # worker's track and the transfer record on the destination's,
+        # and the shared id becomes a cross-worker flow arrow + a skew
+        # constraint in flightplane.merge()
+        edge = fr.next_edge() if fr is not None else None
+        if edge is not None:
+            fr.instant(
+                "transfer.send", worker=src, dst=dst,
+                pages=int(n_pages), edge=edge,
+            )
         t0 = time.perf_counter()
         pred, chunks_k, chunks_v = self.raw_move(
             (pred, chunks_k, chunks_v), dst_device,
@@ -223,8 +234,10 @@ class PageTransferEngine:
         if self.instruments is not None:
             self.instruments.observe_transfer(int(n_pages), nbytes)
         if fr is not None:
+            edge_note = {"edge": edge} if edge is not None else {}
             fr.record(
                 "transfer", ts, time.perf_counter() - t0,
                 worker=dst, src=src, pages=int(n_pages), bytes=nbytes,
+                **edge_note,
             )
         return pred, chunks_k, chunks_v

@@ -23,8 +23,11 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
+from urllib.parse import parse_qs
 
 #: A route handler: () -> (status code, content type, body bytes).
+#: A route with a truthy ``wants_query`` attribute is instead called
+#: with the parsed query-string dict (``parse_qs``) as its one arg.
 Route = Callable[[], tuple[int, str, bytes]]
 
 
@@ -101,13 +104,21 @@ def serve_routes(routes: dict[str, Route], port: int) -> ThreadingHTTPServer:
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            route = routes.get(self.path.split("?")[0])
+            path, _, query = self.path.partition("?")
+            route = routes.get(path)
             if route is None:
                 self.send_error(404)
                 return
             extra: dict[str, str] = {}
             if hasattr(route, "respond"):
                 code, content_type, body, extra = route.respond(self.headers)
+            elif getattr(route, "wants_query", False):
+                # query-aware routes (the /debug/flight poll cursor)
+                # receive the parsed query string; everything else keeps
+                # the zero-arg Route contract untouched
+                code, content_type, body = route(
+                    parse_qs(query) if query else {}
+                )
             else:
                 code, content_type, body = route()
             self.send_response(code)

@@ -73,7 +73,7 @@ import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
 from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
-from beholder_tpu.tracing import current_trace_id
+from beholder_tpu.tracing import current_trace_id, from_traceparent
 
 from .sequence import TelemetrySequenceModel
 
@@ -1138,6 +1138,13 @@ class Request(NamedTuple):
     #: and the ``beholder_control_*`` catalog attributes admissions and
     #: sheds to it.
     tenant: str | None = None
+    #: optional W3C trace context (flight-plane subsystem): the
+    #: ``traceparent`` of the span that caused this request. None (the
+    #: default) changes nothing; set, the serving layer's recorder-only
+    #: request-lifecycle instants inherit the trace id, so a request's
+    #: claim/retire legs join the cross-process trace the ingest wire
+    #: carried in (:mod:`beholder_tpu.obs.flightplane`).
+    traceparent: str | None = None
 
 
 class DeadlineExceededResult:
@@ -1774,8 +1781,19 @@ class ContinuousBatcher:
                         if getattr(req, "tenant", None) is not None
                         else {}
                     )
+                    # a request carrying W3C trace context (the flight
+                    # plane's wire propagation) hands its trace id to
+                    # the lifecycle instant, joining this claim to the
+                    # cross-process trace; without one the shared claim
+                    # trace id applies as before
+                    req_tid = claim_tid
+                    tp = getattr(req, "traceparent", None)
+                    if tp is not None:
+                        pctx = from_traceparent(str(tp))
+                        if pctx is not None:
+                            req_tid = f"{pctx.trace_id:032x}"
                     fr.instant(
-                        "req.claim", trace_id=claim_tid, rid=rid,
+                        "req.claim", trace_id=req_tid, rid=rid,
                         slot=slot, prefix_tokens=int(t),
                         hit_pages=len(hit_pages),
                         horizon=int(req.horizon),

@@ -17,6 +17,15 @@ exposition stay byte-identical) for whatever embeds a
 
 from __future__ import annotations
 
+from .flightplane import (
+    FlightPlane,
+    MergedTimeline,
+    Ring,
+    flight_plane_from_config,
+    load_rings,
+    merge,
+    split_rings,
+)
 from .recorder import DEFAULT_RING_SIZE, FlightRecorder
 from .roofline import (
     PHASE_FAMILIES,
@@ -31,25 +40,71 @@ from .slo import (
     SLOTracker,
     slo_from_config,
 )
-from .timeline import RequestTimeline, TimelineReport, build_timelines
+from .timeline import (
+    RequestTimeline,
+    TimelineReport,
+    build_timelines,
+    phase_walls,
+)
 
 __all__ = [
     "DEFAULT_RING_SIZE",
+    "FlightPlane",
     "FlightRecorder",
     "LatencyDigest",
+    "MergedTimeline",
     "P2Quantile",
     "PHASE_FAMILIES",
     "RequestTimeline",
+    "Ring",
     "RooflineAttributor",
     "SLOConfig",
     "SLOTracker",
     "TimelineReport",
     "attribution_summary",
     "build_timelines",
+    "flight_plane_from_config",
     "flight_recorder_from_config",
+    "load_rings",
+    "merge",
     "model_flops_per_token",
+    "phase_walls",
+    "register_build_info",
     "slo_from_config",
+    "split_rings",
 ]
+
+
+def register_build_info(registry):
+    """Register the ``beholder_build_info`` gauge (value 1.0, labels:
+    artifact schema version, package version, jax version) — called
+    only when the recorder knob is armed, so merged traces and
+    artifacts are attributable to a build while the default exposition
+    stays byte-identical. Version probes are best-effort and
+    import-light (importlib.metadata, never ``import jax``)."""
+    from importlib import metadata
+
+    from beholder_tpu.artifact import SCHEMA_VERSION
+    from beholder_tpu.metrics import get_or_create
+
+    def probe(dist: str) -> str:
+        try:
+            return metadata.version(dist)
+        except Exception:  # noqa: BLE001 - a missing dist is a label, not a crash
+            return "unknown"
+
+    gauge = get_or_create(
+        registry, "gauge", "beholder_build_info",
+        "Build identity (value is always 1; the labels carry it)",
+        labelnames=["schema_version", "package_version", "jax_version"],
+    )
+    gauge.set(
+        1.0,
+        schema_version=str(SCHEMA_VERSION),
+        package_version=probe("beholder-tpu"),
+        jax_version=probe("jax"),
+    )
+    return gauge
 
 
 def flight_recorder_from_config(config) -> FlightRecorder | None:

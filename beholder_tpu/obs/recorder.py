@@ -73,8 +73,23 @@ class FlightRecorder:
         self.attributor = attributor
         self.export_path = export_path
         self.dropped = 0
+        #: max ring occupancy ever observed — a ring that has touched
+        #: its capacity is one event away from dropping
+        self.high_water = 0
+        self._seq = 0
         self._ring: deque[dict[str, Any]] = deque(maxlen=ring_size)
         self._lock = threading.Lock()
+        #: ring identity + clock anchor (set by the flight plane via
+        #: :meth:`set_meta`); rendered as a ``flight.meta`` header line
+        #: in :meth:`jsonl`, never stored in the bounded ring itself
+        self.meta: dict[str, Any] | None = None
+        #: cross-worker edge ids: armed by the flight plane; with no
+        #: plane bound :meth:`next_edge` returns None and the edge
+        #: instrumentation in the cluster layer stays inert
+        self._edge_prefix: str | None = None
+        self._edge_seq = 0
+        self._dropped_counter = None
+        self._high_water_gauge = None
         #: event listeners (e.g. the SLO tracker's streaming fold):
         #: called with each event AFTER it lands in the ring, outside
         #: the ring lock; a listener that raises is swallowed — the
@@ -86,6 +101,51 @@ class FlightRecorder:
         the live-consumption hook (the SLO tracker folds request
         lifecycles from it without waiting for a ring export)."""
         self._listeners.append(listener)
+
+    def set_meta(self, **meta: Any) -> None:
+        """Attach ring identity (worker name, pid) plus a
+        monotonic↔epoch clock anchor — the header the flight plane's
+        cross-worker merge keys skew alignment on. Merged into any
+        previously set meta."""
+        if self.meta is None:
+            self.meta = {}
+        self.meta.update(meta)
+
+    def arm_edges(self, prefix: str) -> None:
+        """Arm cross-worker edge ids (flight-plane bind). ``prefix``
+        namespaces the ids per worker so two workers never mint the
+        same edge."""
+        self._edge_prefix = prefix
+
+    def next_edge(self) -> str | None:
+        """Mint a cross-worker edge id, or None when no flight plane is
+        bound — the cluster layer's send/recv instrumentation keys off
+        this None so the default-OFF ring stays byte-identical."""
+        if self._edge_prefix is None:
+            return None
+        with self._lock:
+            self._edge_seq += 1
+            return f"{self._edge_prefix}-{self._edge_seq}"
+
+    def bind_metrics(self, registry) -> None:
+        """Lazily register drop-pressure series on ``registry``:
+        ``beholder_flight_dropped_total`` (events lost to ring
+        saturation) and ``beholder_flight_ring_high_water`` (max
+        occupancy observed). Only called when the recorder knob is
+        armed — with it off the exposition carries neither series."""
+        from beholder_tpu.metrics import get_or_create
+
+        self._dropped_counter = get_or_create(
+            registry, "counter", "beholder_flight_dropped_total",
+            "Flight-recorder events dropped to ring saturation",
+        )
+        self._high_water_gauge = get_or_create(
+            registry, "gauge", "beholder_flight_ring_high_water",
+            "Max flight-recorder ring occupancy observed",
+        )
+        if self.dropped:
+            self._dropped_counter.inc(self.dropped)
+        self._high_water_gauge.set(float(self.high_water))
 
     # -- recording -------------------------------------------------------
 
@@ -148,9 +208,18 @@ class FlightRecorder:
 
     def _append(self, event: dict[str, Any]) -> None:
         with self._lock:
-            if len(self._ring) == self.ring_size:
+            self._seq += 1
+            event["seq"] = self._seq
+            dropped_now = len(self._ring) == self.ring_size
+            if dropped_now:
                 self.dropped += 1
             self._ring.append(event)
+            if len(self._ring) > self.high_water:
+                self.high_water = len(self._ring)
+                if self._high_water_gauge is not None:
+                    self._high_water_gauge.set(float(self.high_water))
+        if dropped_now and self._dropped_counter is not None:
+            self._dropped_counter.inc()
         for listener in self._listeners:
             try:
                 listener(event)
@@ -163,22 +232,44 @@ class FlightRecorder:
         with self._lock:
             return len(self._ring)
 
-    def events(self) -> list[dict[str, Any]]:
-        """Snapshot of the ring, oldest first."""
+    def events(
+        self, since: int | None = None, limit: int | None = None
+    ) -> list[dict[str, Any]]:
+        """Snapshot of the ring, oldest first. ``since`` keeps only
+        events with ``seq > since`` (the ``?since=`` poll cursor —
+        seq is monotone across the recorder's whole life, so a poller
+        streams increments instead of re-reading the ring); ``limit``
+        caps the snapshot to the first N matching events."""
         with self._lock:
-            return list(self._ring)
+            out = list(self._ring)
+        if since is not None:
+            out = [e for e in out if e.get("seq", 0) > since]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out
 
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self.dropped = 0
 
-    def jsonl(self) -> str:
+    def jsonl(
+        self, since: int | None = None, limit: int | None = None
+    ) -> str:
         """The current ring serialized as JSON lines (one event per
         line) — the shared rendering behind :meth:`dump` and the live
-        ``GET /debug/flight`` endpoint."""
-        return "".join(
-            json.dumps(event, default=str) + "\n" for event in self.events()
+        ``GET /debug/flight`` endpoint. When the flight plane has
+        stamped ring identity a ``flight.meta`` header line leads the
+        stream (rendered here, never stored in the bounded ring)."""
+        head = ""
+        if self.meta is not None:
+            head = json.dumps(
+                {"name": "flight.meta", "ph": "M", **self.meta},
+                default=str,
+            ) + "\n"
+        return head + "".join(
+            json.dumps(event, default=str) + "\n"
+            for event in self.events(since=since, limit=limit)
         )
 
     def dump(self, path: str | None = None) -> str:
@@ -197,9 +288,32 @@ class FlightRecorder:
         """An httpd Route serving the LIVE ring as JSONL — the
         ``GET /debug/flight`` endpoint (wired by ``service.init`` when
         the recorder knob is on), so an operator can inspect the
-        timeline without waiting for the SIGTERM export."""
+        timeline without waiting for the SIGTERM export. Accepts
+        ``?since=<seq>`` + ``limit=<n>`` so a poller streams ring
+        increments instead of the whole ring each probe."""
 
-        def flight_route():
-            return 200, "application/x-ndjson", self.jsonl().encode()
+        def flight_route(query=None):
+            since, limit = parse_cursor(query)
+            body = self.jsonl(since=since, limit=limit).encode()
+            return 200, "application/x-ndjson", body
 
+        flight_route.wants_query = True
         return flight_route
+
+
+def parse_cursor(query) -> tuple[int | None, int | None]:
+    """Decode the shared ``?since=<seq>&limit=<n>`` poll-cursor params
+    (``GET /debug/flight`` and ``/debug/cluster-flight``). ``query`` is
+    the httpd's parse_qs dict (or None); malformed values read as
+    absent — a bad cursor must degrade to the full ring, not a 500."""
+    since = limit = None
+    if query:
+        try:
+            since = int(query["since"][0])
+        except (KeyError, IndexError, ValueError, TypeError):
+            since = None
+        try:
+            limit = int(query["limit"][0])
+        except (KeyError, IndexError, ValueError, TypeError):
+            limit = None
+    return since, limit

@@ -383,3 +383,33 @@ def build_timelines(events: Iterable[dict[str, Any]]) -> TimelineReport:
         attributed_s=attributed_s,
         unattributed_s=unattributed_s,
     )
+
+
+def phase_walls(
+    events: Iterable[dict[str, Any]],
+) -> dict[str, dict[str, float]]:
+    """Aggregate phase wall per ``(phase, worker)`` plus per kernel
+    family — the unit :mod:`beholder_tpu.tools.perf_explain` diffs two
+    runs on. Returns ``{"phases": {"<phase>@<worker>": seconds},
+    "families": {"<family>@<worker>": seconds}}``; worker-less events
+    (single-engine runs) aggregate under ``all``. Nested slices
+    (``device_wait``) are excluded exactly like the per-request
+    attribution above, so the totals reconcile with the same wall."""
+    phases: dict[str, float] = {}
+    families: dict[str, float] = {}
+    for event in events:
+        if event.get("ph", "X") != "X":
+            continue
+        name = str(event.get("name", ""))
+        if name in _NESTED_SLICES:
+            continue
+        args = event.get("args", {}) or {}
+        worker = str(args.get("worker") or "all")
+        dur_s = int(event.get("dur_us", 0)) / 1e6
+        key = f"{name}@{worker}"
+        phases[key] = phases.get(key, 0.0) + dur_s
+        family = args.get("family")
+        if family:
+            fkey = f"{family}@{worker}"
+            families[fkey] = families.get(fkey, 0.0) + dur_s
+    return {"phases": phases, "families": families}

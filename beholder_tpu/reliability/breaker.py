@@ -229,7 +229,8 @@ class ResilientTransport(HttpTransport):
         self.default_deadline_s = default_deadline_s
         self._log = logger or get_logger("reliability.transport")
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
         deadline = current_deadline()
         if deadline is None and self.default_deadline_s is not None:
             deadline = Deadline.after(self.default_deadline_s)
@@ -244,9 +245,13 @@ class ResilientTransport(HttpTransport):
                 raise BreakerOpenError(
                     self.breaker.name, self.breaker.retry_after_s()
                 )
+            # headers forwarded only when set: duck-typed transports
+            # predating the headers kwarg keep working headerless
+            extra = {"headers": headers} if headers is not None else {}
             try:
                 resp = self.inner.request(
-                    method, url, params=params, json=json, timeout=per_attempt
+                    method, url, params=params, json=json,
+                    timeout=per_attempt, **extra,
                 )
             except BaseException:
                 self.breaker.record_failure()

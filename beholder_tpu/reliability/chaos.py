@@ -69,7 +69,8 @@ class FlakyTransport(HttpTransport):
             self._fail_exc = exc
             self._fail_status = status
 
-    def request(self, method, url, *, params=None, json=None, timeout=10.0):
+    def request(self, method, url, *, params=None, json=None, timeout=10.0,
+                headers=None):
         if self.delay_s:
             time.sleep(self.delay_s)
         with self._lock:
@@ -88,8 +89,12 @@ class FlakyTransport(HttpTransport):
             raise exc if exc is not None else ConnectionError(
                 "chaos: injected transport fault"
             )
+        # headers forwarded only when set: duck-typed transports
+        # predating the headers kwarg keep working headerless
+        extra = {"headers": headers} if headers is not None else {}
         return self.inner.request(
-            method, url, params=params, json=json, timeout=timeout
+            method, url, params=params, json=json, timeout=timeout,
+            **extra,
         )
 
 

@@ -229,9 +229,33 @@ class BeholderService:
         #: the configured ``export_path`` so short-lived runs keep their
         #: timeline. Disabled it is None — serving behavior and the
         #: default exposition stay byte-identical.
-        from beholder_tpu.obs import flight_recorder_from_config
+        from beholder_tpu.obs import (
+            flight_plane_from_config,
+            flight_recorder_from_config,
+        )
 
         self.flight_recorder = flight_recorder_from_config(config)
+        if self.flight_recorder is not None:
+            # drop-pressure series (dropped counter + ring high-water
+            # gauge) and the beholder_build_info gauge register ONLY
+            # when the recorder knob is armed — off, the exposition is
+            # byte-identical
+            self.flight_recorder.bind_metrics(self.metrics.registry)
+            from beholder_tpu.obs import register_build_info
+
+            register_build_info(self.metrics.registry)
+
+        #: optional cluster-wide flight plane (``instance.observability.
+        #: flight_plane.*``; OFF by default ⇒ wire bytes, serving
+        #: output, and the default exposition stay byte-identical).
+        #: Armed, it stamps this process's ring with worker identity +
+        #: a clock anchor, arms cross-worker edge ids, propagates W3C
+        #: ``traceparent`` onto outbound HTTP (TracingTransport below)
+        #: and AMQP headers, serves the merged cluster timeline at
+        #: ``GET /debug/cluster-flight``, and dumps it at SIGTERM.
+        self.flight_plane = flight_plane_from_config(config)
+        if self.flight_plane is not None and self.flight_recorder is not None:
+            self.flight_plane.bind(self.flight_recorder)
 
         #: fused paged verify/prefix attention
         #: (``instance.serving.fused_verify``; OFF by default) plus the
@@ -328,6 +352,19 @@ class BeholderService:
                 registry=self.metrics.registry,
                 flight_recorder=self.flight_recorder,
             )
+
+        if self.flight_plane is not None:
+            # trace-context propagation, OUTERMOST on the transport
+            # chain (above caching: a cache hit has no wire request to
+            # stamp): every egress call carries the active span's W3C
+            # traceparent. Only built when the plane is armed — off,
+            # no wrapper exists and outbound bytes are unchanged.
+            from beholder_tpu.clients.http import (
+                RequestsTransport,
+                TracingTransport,
+            )
+
+            transport = TracingTransport(transport or RequestsTransport())
 
         deadline_s = float(config.get("instance.http.deadline_s", 10.0))
         self.trello = TrelloClient(
@@ -548,6 +585,16 @@ class BeholderService:
         ):
             try:
                 self.flight_recorder.dump()
+            except Exception:  # noqa: BLE001
+                pass
+        if (
+            self.flight_plane is not None
+            and self.flight_plane.export_path
+        ):
+            # the MERGED cluster timeline (skew-aligned, flow-edged)
+            # dumps alongside the raw ring
+            try:
+                self.flight_plane.dump()
             except Exception:  # noqa: BLE001
                 pass
         self.metrics.close()
@@ -866,6 +913,12 @@ def init(
         if service.flight_recorder is not None:
             metrics.add_route(
                 "/debug/flight", service.flight_recorder.route()
+            )
+        if service.flight_plane is not None:
+            # GET /debug/cluster-flight: the LIVE skew-aligned merged
+            # timeline (same ?since=/limit poll cursor as /debug/flight)
+            metrics.add_route(
+                "/debug/cluster-flight", service.flight_plane.route()
             )
 
         #: optional /healthz + /readyz endpoint (extension; the reference

@@ -485,7 +485,7 @@ def run_gate(baseline: dict, current: dict) -> dict[str, Any]:
         for name, extract in REPORTED_ABSOLUTES
     }
     failed = [c["metric"] for c in checks if not c["ok"]]
-    return {
+    verdict = {
         "schema": SCHEMA,
         "verdict": "fail" if failed else "pass",
         "failed": failed,
@@ -498,6 +498,18 @@ def run_gate(baseline: dict, current: dict) -> dict[str, Any]:
             "(BENCH_NOTES.md: ±30% host swings)"
         ),
     }
+    if failed:
+        # every band failure arrives pre-attributed: the ranked
+        # phase/worker/family explanation rides the verdict so CI
+        # says WHAT moved, not just that something did. Best-effort —
+        # an explain error must never change the gate's answer.
+        try:
+            from beholder_tpu.tools.perf_explain import explain_artifacts
+
+            verdict["explanation"] = explain_artifacts(baseline, current)
+        except Exception as err:  # noqa: BLE001 - the gate is the product
+            verdict["explanation_error"] = repr(err)
+    return verdict
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -524,6 +536,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--out", default=None, help="also write the verdict JSON here"
     )
+    parser.add_argument(
+        "--explain-out", default=None,
+        help=(
+            "also write the phase-level explanation JSON here "
+            "(perf_explain over the same two artifacts, regardless of "
+            "the gate's verdict — CI uploads it next to the verdict)"
+        ),
+    )
     args = parser.parse_args(argv)
 
     baseline = validate_file(args.baseline)
@@ -543,6 +563,15 @@ def main(argv: list[str] | None = None) -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(rendered + "\n")
+    if args.explain_out:
+        from beholder_tpu.tools.perf_explain import explain_artifacts
+
+        with open(args.explain_out, "w") as f:
+            f.write(
+                json.dumps(
+                    explain_artifacts(baseline, current), indent=1
+                ) + "\n"
+            )
     return 0 if verdict["verdict"] == "pass" else 1
 
 

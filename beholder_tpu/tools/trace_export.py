@@ -108,6 +108,10 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
         },
     ]
     for event in events:
+        if event.get("ph") == "M":
+            # flight-plane header lines (flight.meta / flight.plane)
+            # carry ring identity, not timeline content
+            continue
         trace_id = event.get("trace_id")
         worker = (event.get("args") or {}).get("worker")
         row = worker_tid(str(worker)) if worker else tid(trace_id)
@@ -129,6 +133,7 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
         elif out["ph"] == "i":
             out["s"] = "t"  # thread-scoped instant marker
         trace_events.append(out)
+    trace_events.extend(_flow_events(events, worker_tid))
     # one named track per trace: the trace id prefix is enough to join
     # against span reports without 32 hex chars of track label
     for trace_id, row in tid_of.items():
@@ -153,6 +158,72 @@ def chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
             }
         )
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def _flow_events(
+    events: list[dict[str, Any]], worker_tid
+) -> list[dict[str, Any]]:
+    """Cross-worker flow arrows (``ph="s"`` start / ``ph="f"`` finish
+    pairs sharing an ``id``) for a flight-plane merged timeline:
+
+    - **edge pairs** — a ``<base>.send`` instant and the receiving
+      event tagged with the same ``args["edge"]`` (transfer handoffs,
+      drain restocks) become one arrow from the sender's track to the
+      receiver's;
+    - **recovery legs** — a ``req.recovered`` instant chains to the
+      SAME gid's next ``req.claim`` on the surviving worker, so a
+      failover re-admission reads as an arrow from the dying worker to
+      wherever the request landed.
+
+    Events without edges/gids produce nothing — a plane-less ring
+    exports byte-identically to before."""
+    flows: list[dict[str, Any]] = []
+
+    def arrow(flow_id: str, name: str, src_ev, dst_ev) -> None:
+        src_worker = (src_ev.get("args") or {}).get("worker")
+        dst_worker = (dst_ev.get("args") or {}).get("worker")
+        if not src_worker or not dst_worker:
+            return
+        flows.append({
+            "name": name, "ph": "s", "id": flow_id, "pid": 1,
+            "tid": worker_tid(str(src_worker)),
+            "ts": int(src_ev.get("ts_us", 0)), "cat": "flow",
+        })
+        flows.append({
+            "name": name, "ph": "f", "bp": "e", "id": flow_id, "pid": 1,
+            "tid": worker_tid(str(dst_worker)),
+            "ts": int(dst_ev.get("ts_us", 0)), "cat": "flow",
+        })
+
+    sends: dict[str, dict[str, Any]] = {}
+    recvs: dict[str, dict[str, Any]] = {}
+    recovered: list[dict[str, Any]] = []
+    claims: dict[str, list[dict[str, Any]]] = {}
+    for event in events:
+        args = event.get("args") or {}
+        edge = args.get("edge")
+        name = str(event.get("name", ""))
+        if edge:
+            (sends if name.endswith(".send") else recvs)[str(edge)] = event
+        if name == "req.recovered" and args.get("gid"):
+            recovered.append(event)
+        elif name == "req.claim" and args.get("gid"):
+            claims.setdefault(str(args["gid"]), []).append(event)
+    for edge in sorted(sends.keys() & recvs.keys()):
+        send, recv = sends[edge], recvs[edge]
+        base = str(send["name"]).removesuffix(".send")
+        arrow(str(edge), base, send, recv)
+    for k, rec in enumerate(recovered):
+        gid = str((rec.get("args") or {})["gid"])
+        rec_ts = int(rec.get("ts_us", 0))
+        after = [
+            c for c in claims.get(gid, ())
+            if int(c.get("ts_us", 0)) >= rec_ts
+        ]
+        if after:
+            nxt = min(after, key=lambda c: int(c.get("ts_us", 0)))
+            arrow(f"rec-{gid}-{k}", "recovery", rec, nxt)
+    return flows
 
 
 def export(events_or_path, out_path: str) -> str:

@@ -34,6 +34,10 @@ from secrets import randbits
 from typing import Any, Callable
 
 TRACE_HEADER = "uber-trace-id"
+#: W3C Trace Context header (the flight plane's wire format): spans the
+#: AMQP headers table, HTTP client requests, and Request metadata, so a
+#: trace started at message receive survives every cross-process hop.
+W3C_HEADER = "traceparent"
 FLAG_SAMPLED = 0x01
 
 #: The span context active in this task/thread — set by ``with span:``
@@ -97,16 +101,56 @@ def inject(ctx: SpanContext, carrier: dict) -> dict:
 
 def extract(carrier: dict | None) -> SpanContext | None:
     """Read a :class:`SpanContext` out of a headers carrier; None if absent
-    or malformed (a broken upstream header must never kill a consumer)."""
+    or malformed (a broken upstream header must never kill a consumer).
+    Falls back to the W3C ``traceparent`` entry when the jaeger header is
+    absent — read-side W3C support is always on (reading an extra header
+    changes no bytes), only the WRITE side sits behind the flight-plane
+    knob."""
     if not carrier:
         return None
     value = carrier.get(TRACE_HEADER)
-    if not value:
-        return None
+    if value:
+        try:
+            return SpanContext.decode(str(value))
+        except (ValueError, AttributeError):
+            return None
+    w3c = carrier.get(W3C_HEADER)
+    if w3c:
+        return from_traceparent(str(w3c))
+    return None
+
+
+def to_traceparent(ctx: SpanContext) -> str:
+    """Render ``ctx`` as a W3C ``traceparent`` value
+    (``00-{trace:032x}-{span:016x}-{flags:02x}``). The parent id does
+    not travel — W3C carries only the direct ancestor, which is exactly
+    what a child span needs."""
+    return f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-{ctx.flags & 0xFF:02x}"
+
+
+def from_traceparent(value: str) -> SpanContext | None:
+    """Parse a W3C ``traceparent`` value; None on malformed input or the
+    all-zero trace/span ids the spec marks invalid."""
     try:
-        return SpanContext.decode(str(value))
+        version, trace_hex, span_hex, flags_hex = value.strip().split("-")
+        if len(trace_hex) != 32 or len(span_hex) != 16:
+            return None
+        int(version, 16)
+        trace_id = int(trace_hex, 16)
+        span_id = int(span_hex, 16)
+        flags = int(flags_hex, 16)
     except (ValueError, AttributeError):
         return None
+    if trace_id == 0 or span_id == 0:
+        return None
+    return SpanContext(trace_id, span_id, 0, flags)
+
+
+def inject_traceparent(ctx: SpanContext, carrier: dict) -> dict:
+    """Write the W3C form of ``ctx`` into a headers carrier (the flight
+    plane's armed write side)."""
+    carrier[W3C_HEADER] = to_traceparent(ctx)
+    return carrier
 
 
 class Span:

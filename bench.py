@@ -1853,6 +1853,124 @@ def bench_failover() -> dict:
     }
 
 
+def bench_flightplane() -> dict:
+    """The cluster-wide flight plane, exercised on a REAL run: a
+    2-shard disaggregated cluster (dedicated prefill worker, page
+    handoffs to the owning decode shard) serves a decode-heavy trace
+    with one decode shard killed mid-stream (one injected recovery),
+    every worker's events landing in a plane-bound recorder. The
+    process ring then splits per worker, merges back through the
+    skew-aligning fold, and exports BOTH committed artifacts under
+    ``artifacts/flight/``: the merged JSONL timeline and the Perfetto
+    trace whose handoff/transfer/recovery legs render as cross-worker
+    flow arrows (the v12 acceptance evidence). The v12 artifact block
+    records the merge summary; the headline value is merge throughput
+    (events folded per second, host-normalized like every other
+    absolute — reported, never gated)."""
+    import jax
+    import numpy as np
+
+    from beholder_tpu import metrics as metrics_mod
+    from beholder_tpu.cluster import ClusterConfig, FailoverConfig
+    from beholder_tpu.cluster.router import ClusterScheduler
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+    from beholder_tpu.models.serving import Request
+    from beholder_tpu.obs import FlightPlane, FlightRecorder, merge
+    from beholder_tpu.proto import TelemetryStatusEntry
+    from beholder_tpu.reliability.chaos import (
+        WorkerFault,
+        inject_worker_fault,
+    )
+    from beholder_tpu.tools import trace_export
+
+    page, slots = 8, 4
+    model = TelemetrySequenceModel(dim=64, heads=4, kv_heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 64, model=model)
+    kw = dict(
+        num_pages=96, page_size=page, slots=slots, max_prefix=64,
+        max_pages_per_seq=24,
+    )
+
+    def mk_request(seed, t, horizon):
+        r = np.random.default_rng(1400 + seed)
+        prog = np.cumsum(1.0 + r.normal(0, 0.05, t + 1))
+        stats = np.full(len(prog), int(TelemetryStatusEntry.CONVERTING))
+        return Request(prog, stats, horizon)
+
+    trace = [mk_request(i, 8, 32) for i in range(10)]
+    registry = metrics_mod.Registry()
+    recorder = FlightRecorder(ring_size=8192)
+    plane = FlightPlane(worker="bench-host")
+    plane.bind(recorder)
+    cluster = ClusterScheduler(
+        model, state.params,
+        ClusterConfig(
+            n_decode_workers=2, n_prefill_workers=1,
+            failover=FailoverConfig(),
+        ),
+        metrics=registry, flight_recorder=recorder, **kw,
+    )
+    cluster.run(trace)  # warm pass: compiles
+    recorder.clear()    # the committed timeline covers the timed run
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=1)
+    )
+    cluster.run(trace)
+
+    rings = plane.rings()
+    t0 = time.perf_counter()
+    merged = merge(rings)
+    merge_s = max(time.perf_counter() - t0, 1e-9)
+
+    out_dir = os.path.join(
+        os.environ.get("BENCH_ARTIFACT_DIR") or artifact.DEFAULT_DIR,
+        "flight",
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    events_path = os.path.join(out_dir, "cluster_flight.jsonl")
+    with open(events_path, "w") as f:
+        f.write(merged.jsonl())
+    trace_path = trace_export.export(
+        merged.events, os.path.join(out_dir, "cluster_flight.trace.json")
+    )
+    with open(trace_path) as f:
+        flow_arrows = sum(
+            1 for e in json.load(f)["traceEvents"] if e.get("ph") == "s"
+        )
+
+    artifact.record_flight_plane(merged.summary)
+    artifact.record_raw(
+        "obs.flightplane_merge", "trial_wall", [merge_s],
+        events=len(merged.events),
+    )
+
+    return {
+        "metric": "flightplane_merge_events_per_sec",
+        "value": round(len(merged.events) / merge_s, 1),
+        "workers": int(merged.summary["workers"]),
+        "merged_events": int(merged.summary["merged_events"]),
+        "flow_edges": int(merged.summary["flow_edges"]),
+        "flow_arrows_rendered": flow_arrows,
+        "recoveries": cluster.failover.recovered_total,
+        "max_abs_skew_us": merged.summary["max_abs_skew_us"],
+        "events_path": events_path,
+        "trace_path": trace_path,
+        "devices": jax.device_count(),
+        "note": (
+            "10-request decode-heavy trace on a 2-decode-shard "
+            "disaggregated cluster (dedicated prefill worker) with "
+            "decode-1 killed after its first timed dispatch: the "
+            "plane-bound ring splits per worker and merges back "
+            "through the skew-aligned fold. The committed "
+            "cluster_flight.{jsonl,trace.json} carry the v12 "
+            "acceptance evidence — handoff/transfer + recovery legs "
+            "as cross-worker flow arrows on ONE causally-ordered "
+            "timeline. value = merge fold throughput (reported, "
+            "never gated)."
+        ),
+    }
+
+
 def bench_slo() -> dict:
     """The request-level SLO engine, measured on a live serving run:
     a decode-heavy request mix rides the bounded intake
@@ -2984,6 +3102,12 @@ def _e2e_main(rec: artifact.ArtifactRecorder) -> None:
     # tenant-fair DRR, interleaved (victim_ttft_ratio > 0 is the CI
     # acceptance gate), plus the k-shed and autoscale exercises
     secondary["control"] = rec.section("control", bench_control())
+    # and the v12 flight-plane block: the disaggregated kill-recovery
+    # run merged into ONE cross-worker timeline (flow_edges > 0 is the
+    # CI acceptance gate), with the committed artifacts/flight trace
+    secondary["flightplane"] = rec.section(
+        "flightplane", bench_flightplane()
+    )
     print(
         json.dumps(
             {
@@ -3059,6 +3183,16 @@ def _kernel_main(rec: artifact.ArtifactRecorder) -> None:
     print(json.dumps(result))
 
 
+def _flight_main(rec: artifact.ArtifactRecorder) -> None:
+    """``make bench-flight``: just the flight-plane scenario — the
+    disaggregated kill-recovery run, per-worker ring split, the
+    skew-aligned merge, and the committed artifacts/flight exports
+    (run it under the forced 8-device host-platform mesh for real
+    cross-device handoffs)."""
+    result = rec.section("flightplane", bench_flightplane())
+    print(json.dumps(result))
+
+
 def _control_main(rec: artifact.ArtifactRecorder) -> None:
     """``make bench-control``: just the control-plane scenarios — the
     tenant-skew fairness replay (FIFO vs DRR, interleaved) plus the
@@ -3079,6 +3213,7 @@ def main() -> None:
     kernel_only = "--kernel-only" in sys.argv
     ingest_only = "--ingest-only" in sys.argv
     control_only = "--control-only" in sys.argv
+    flight_only = "--flight-only" in sys.argv
     # EVERY bench run leaves a schema-versioned raw artifact behind —
     # including error and skip outcomes (VERDICT round-5 "What's
     # missing" item 1: perf claims need committed raw files, not prose)
@@ -3092,6 +3227,7 @@ def main() -> None:
         else "bench_kernel" if kernel_only
         else "bench_ingest" if ingest_only
         else "bench_control" if control_only
+        else "bench_flightplane" if flight_only
         else "bench_e2e"
     )
     rec.sections["config"] = {
@@ -3117,6 +3253,8 @@ def main() -> None:
             _ingest_main(rec)
         elif control_only:
             _control_main(rec)
+        elif flight_only:
+            _flight_main(rec)
         else:
             _e2e_main(rec)
     except BaseException as err:

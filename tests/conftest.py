@@ -27,3 +27,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: many tests rebuild byte-identical
+# programs (same dim-32 model, same block sizes) in fresh jit wrappers,
+# which the in-process cache cannot dedupe — the disk cache can, both
+# within one cold run and across runs. Keyed by HLO hash, so compiled
+# artifacts (and therefore test outputs) are unchanged.
+_cache_dir = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR",
+    str(Path(__file__).resolve().parent.parent / ".cache" / "jax"),
+)
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # older jax without the persistent cache: run without
+    pass
