@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention perf-gate lint clean
 
 all: proto native
 
@@ -131,6 +131,18 @@ bench-flight:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python bench.py --flight-only
 
+# the tail-based-retention scenario alone: interleaved plain-vs-armed
+# serving passes (the TraceVault attached as an extra recorder
+# listener — min(armed)/min(plain) is the overhead figure the perf
+# gate bands, higher fails) plus the sentinel incident replay: the
+# recorded slices re-folded with the dominant phase slowed 8x, the
+# verdict naming that phase@worker, an incident opened on the vault,
+# and a stamped tail trace exported Perfetto-loadable (writes
+# artifacts/bench_retention.json plus the committed
+# artifacts/retention/{incident_replay.json,incident_trace.trace.json})
+bench-retention:
+	python bench.py --retention-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -155,6 +167,8 @@ perf-gate:
 		--baseline artifacts/bench_ingest.json --current artifacts/bench_ingest.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_control.json --current artifacts/bench_control.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_retention.json --current artifacts/bench_retention.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -69,7 +69,10 @@ Schema (``validate`` is the authoritative checker)::
                   "scale_events": 0.0},  # v11: control plane
       "flight_plane": {"workers": 0.0, "merged_events": 0.0,
                        "flow_edges": 0.0,
-                       "max_abs_skew_us": 0.0}  # v12: flight plane
+                       "max_abs_skew_us": 0.0},  # v12: flight plane
+      "retention": {"kept": 0.0, "evaluated": 0.0, "keep_rate": 0.0,
+                    "overhead_ratio": 0.0,
+                    "incidents": 0.0}  # v13: tail-based retention
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -170,6 +173,15 @@ how many worker rings folded into the merged timeline, the merged
 event count, the matched cross-worker edge pairs (transfer/handoff/
 restock flow arrows), and the worst absolute clock skew the merge
 aligned away. v1-v11 artifacts remain valid.
+
+Schema v13 (the tail-based-retention PR): the run's retention evidence
+rides along (:meth:`ArtifactRecorder.record_retention`) — how many
+retired requests the vault evaluated and kept (with the derived
+``keep_rate``), ``overhead_ratio`` (armed serving wall / plain serving
+wall, both passes interleaved on the same host in the same session;
+the perf gate bands it, degradation = the ratio RISING — always-on
+retention must stay cheap enough to leave on), and the incidents the
+sentinel/burn triggers opened. v1-v12 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -181,7 +193,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -305,6 +317,17 @@ EMPTY_FLIGHT_PLANE = {
     "max_abs_skew_us": 0.0,
 }
 
+#: v13: the retention block's required shape (an empty block is valid
+#: — a run that never armed the trace vault still writes a v13
+#: artifact)
+EMPTY_RETENTION = {
+    "kept": 0.0,
+    "evaluated": 0.0,
+    "keep_rate": 0.0,
+    "overhead_ratio": 0.0,
+    "incidents": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -390,6 +413,7 @@ class ArtifactRecorder:
         self.ingest: dict[str, float] = dict(EMPTY_INGEST)
         self.control: dict[str, Any] = copy.deepcopy(EMPTY_CONTROL)
         self.flight_plane: dict[str, float] = dict(EMPTY_FLIGHT_PLANE)
+        self.retention: dict[str, float] = dict(EMPTY_RETENTION)
 
     def section(
         self,
@@ -592,6 +616,19 @@ class ArtifactRecorder:
             key: float(summary[key]) for key in EMPTY_FLIGHT_PLANE
         }
 
+    def record_retention(self, summary: dict[str, Any]) -> None:
+        """Adopt one tail-based retention summary
+        (:meth:`beholder_tpu.obs.retention.TraceVault.artifact_summary`
+        plus the bench's interleaved ``overhead_ratio``) as the run's
+        v13 ``retention`` block. Last writer wins — the block carries
+        the HEADLINE armed-vs-plain serving comparison."""
+        for key in EMPTY_RETENTION:
+            if key not in summary:
+                raise ValueError(f"retention summary missing {key!r}")
+        self.retention = {
+            key: float(summary[key]) for key in EMPTY_RETENTION
+        }
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -641,6 +678,7 @@ class ArtifactRecorder:
             "ingest": dict(self.ingest),
             "control": copy.deepcopy(self.control),
             "flight_plane": dict(self.flight_plane),
+            "retention": dict(self.retention),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -763,6 +801,14 @@ def record_flight_plane(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_flight_plane(summary)
+
+
+def record_retention(summary: dict) -> None:
+    """Adopt a tail-based retention summary into the active recorder's
+    v13 ``retention`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_retention(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -969,6 +1015,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"flight_plane.{key} must be a number, "
                         f"got {plane.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 13:
+        # v13: tail-based retention evidence
+        retention = obj.get("retention")
+        if not isinstance(retention, dict):
+            problems.append("retention must be a dict (schema v13+)")
+        else:
+            for key in EMPTY_RETENTION:
+                if not isinstance(retention.get(key), (int, float)):
+                    problems.append(
+                        f"retention.{key} must be a number, "
+                        f"got {retention.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

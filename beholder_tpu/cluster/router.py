@@ -1081,7 +1081,12 @@ class ClusterScheduler:
                 b._metrics.slots_active.set(
                     sum(r is not None for r in req_of)
                 )
-                b._metrics.pool_pages_free.set(free_pages())
+                free_now = free_pages()
+                b._metrics.pool_pages_free.set(free_now)
+                b._metrics.pool_pressure_from(
+                    free_now, req_of, requests, total_need,
+                    b.max_pages_per_seq,
+                )
             if not any(r is not None for r in req_of):
                 continue
 
@@ -1123,7 +1128,12 @@ class ClusterScheduler:
                     b._metrics.slots_active.set(
                         sum(r is not None for r in req_of)
                     )
-                    b._metrics.pool_pages_free.set(free_pages())
+                    free_now = free_pages()
+                    b._metrics.pool_pages_free.set(free_now)
+                    b._metrics.pool_pressure_from(
+                        free_now, req_of, requests, total_need,
+                        b.max_pages_per_seq,
+                    )
 
         # ONE packed readback, exactly the single-engine discipline
         if snap_batches:

@@ -299,6 +299,14 @@ def health_from_config(config, service) -> HealthServer | None:
         # than the page-now alert tolerates — /healthz says so
         add_slo_check(server, lambda: getattr(service, "slo", None))
 
+    if getattr(service, "sentinel", None) is not None:
+        # online regression detection: an open sentinel verdict (a
+        # phase@worker regressed fast-vs-baseline, hysteresis applied)
+        # degrades /healthz beside the SLO burn check
+        add_sentinel_check(
+            server, lambda: getattr(service, "sentinel", None)
+        )
+
     server.start()
     server.set_ready(True)
     return server
@@ -348,3 +356,24 @@ def add_slo_check(server: HealthServer, tracker) -> None:
         return detail
 
     server.add_check("slo", slo_check)
+
+
+def add_sentinel_check(server: HealthServer, sentinel) -> None:
+    """Register the ``sentinel`` health check for a
+    :class:`~beholder_tpu.obs.sentinel.Sentinel` (or a zero-arg
+    callable resolving to one at probe time — None means "configured
+    but not attached yet", a healthy answer): the check fails
+    (degrading ``/healthz`` to 503) while a regression verdict is OPEN
+    — the hysteretic fast-vs-baseline attribution breach — and
+    otherwise returns the check/breach counters as detail."""
+
+    def sentinel_check():
+        target = sentinel() if callable(sentinel) else sentinel
+        if target is None:
+            return "sentinel configured; not attached"
+        healthy, detail = target.health()
+        if not healthy:
+            raise RuntimeError(detail)
+        return detail
+
+    server.add_check("sentinel", sentinel_check)

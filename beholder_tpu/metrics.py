@@ -258,14 +258,26 @@ class Histogram(_Labelled):
         by the bucket's ``le`` rendering (``"+Inf"`` for the overflow
         bucket): ``{"0.05": {"trace_id", "value", "ts_us"}, ...}`` — the
         one-click link from a slow bucket to its flight-recorder /
-        span timeline."""
+        span timeline. When the tail-based retention vault is armed
+        (:func:`set_exemplar_resolver`) and holds the exemplar's
+        trace, a ``trace_ref`` field carries the vault id — absent
+        otherwise, so the retention-off shape is unchanged."""
         key = self._key(labels)
         with self._lock:
             found = dict(self._exemplars.get(key, ()))
+        resolver = _exemplar_resolver
         out: dict[str, dict] = {}
         for idx, ex in sorted(found.items()):
             le = _fmt(self.buckets[idx]) if idx < len(self.buckets) else "+Inf"
-            out[le] = dict(ex)
+            entry = dict(ex)
+            if resolver is not None:
+                try:
+                    ref = resolver(entry.get("trace_id"))
+                except Exception:  # noqa: BLE001 - a join must not break reads
+                    ref = None
+                if ref is not None:
+                    entry["trace_ref"] = ref
+            out[le] = entry
         return out
 
     def time(self, **labels: str) -> "_HistogramTimer":
@@ -382,6 +394,25 @@ def configure_observation_log(
                 pass
         _obs_file = None
         _obs_file_path = None
+
+
+#: exemplar -> retained-trace join (observability retention): a
+#: callable mapping a trace id to the tail-based vault's id for it, or
+#: None. Module-global for the same reason as the observation log —
+#: histograms are constructed all over the tree, long before (and
+#: regardless of whether) a vault exists. Unset (the default) leaves
+#: exemplar payload shapes untouched — the retention-off pin.
+_exemplar_resolver = None
+
+
+def set_exemplar_resolver(resolver) -> None:
+    """Install (or, with ``None``, remove) the exemplar trace_ref
+    resolver — ``resolver(trace_id) -> vault_id | None``. Wired by the
+    service when the retention knob is armed; resolved lazily at
+    :meth:`Histogram.exemplars` render time so exemplars recorded
+    before the trace retired still link once the vault keeps it."""
+    global _exemplar_resolver
+    _exemplar_resolver = resolver
 
 
 def _obs_rotation_policy() -> tuple[int, int]:

@@ -1256,6 +1256,92 @@ class _ServingMetrics:
             buckets=self.TOKEN_BUCKETS,
         )
         self.pool_pages_free.set(num_pages)
+        # pool-pressure gauges (PR 16) register LAZILY on first feed:
+        # beholder_serving_pool_fragmentation and the per-tenant
+        # committed-pages series appear only once a scheduler actually
+        # reports pressure, keeping every pre-existing exposition pin
+        # (which renders this registry before a run) byte-identical
+        self._registry = registry
+        self._pool_frag = None
+        self._tenant_pages = None
+        self._tenants_seen: set = set()
+
+    def pool_pressure(
+        self,
+        free: int,
+        claimable: int,
+        committed: dict | None = None,
+    ) -> None:
+        """Feed the lazily-registered pool observability gauges at a
+        ``pool_pages_free`` update site. ``free`` is the pool's free
+        page count, ``claimable`` the largest run of those pages ONE
+        request could claim right now (bounded by the per-seq page cap
+        and slot availability — under paging indirection that cap, not
+        physical adjacency, is what strands free pages), ``committed``
+        maps tenant id -> pages reserved by that tenant's in-flight
+        requests. Fragmentation renders as ``claimable / free`` (1.0 =
+        any free page is claimable; 0.0 = pages exist but no request
+        can take one; between = pages stranded behind the per-seq cap
+        for a single claimant)."""
+        from beholder_tpu.metrics import get_or_create
+
+        if self._pool_frag is None:
+            self._pool_frag = get_or_create(
+                self._registry, "gauge",
+                "beholder_serving_pool_fragmentation",
+                "Largest single-request-claimable free page run over "
+                "free pages (1 = unfragmented; < 1 = free pages "
+                "stranded behind the per-seq cap or slot exhaustion)",
+            )
+        self._pool_frag.set(
+            round(min(claimable, free) / free, 6) if free > 0 else 1.0
+        )
+        if committed is None or (
+            not committed and self._tenant_pages is None
+        ):
+            # the tenant family first registers when a TENANTED request
+            # actually commits pages — an all-untenanted run adds no
+            # empty metric family to the exposition
+            return
+        if self._tenant_pages is None:
+            self._tenant_pages = get_or_create(
+                self._registry, "gauge",
+                "beholder_serving_tenant_committed_pages",
+                "KV pages committed to a tenant's in-flight requests",
+                labelnames=["tenant"],
+            )
+        for tenant, pages in committed.items():
+            label = str(tenant)
+            self._tenants_seen.add(label)
+            self._tenant_pages.set(float(pages), tenant=label)
+        # a tenant whose last request retired must read 0, not its
+        # final in-flight value frozen forever
+        for label in self._tenants_seen - {str(t) for t in committed}:
+            self._tenant_pages.set(0.0, tenant=label)
+
+    def pool_pressure_from(
+        self, free, req_of, requests, total_need, page_cap
+    ) -> None:
+        """Site-shaped :meth:`pool_pressure` feed from the scheduler
+        loops' shared bookkeeping: ``req_of`` (slot -> rid or None),
+        ``requests`` (rid-indexable, each optionally carrying
+        ``tenant``), ``total_need`` (per-slot pages at horizon end) and
+        the per-seq page cap. Shared by run(), the spec scheduler and
+        the cluster router — all three keep identical host mirrors."""
+        slot_open = any(r is None for r in req_of)
+        claimable = min(free, page_cap) if slot_open and free > 0 else 0
+        committed: dict[str, int] = {}
+        for slot, rid in enumerate(req_of):
+            if rid is None:
+                continue
+            tenant = getattr(requests[rid], "tenant", None)
+            if tenant is None:
+                continue
+            label = str(tenant)
+            committed[label] = committed.get(label, 0) + int(
+                total_need[slot]
+            )
+        self.pool_pressure(free, claimable, committed)
 
     def served(self, n_requests: int, n_tokens: int) -> None:
         self.requests_total.inc(n_requests)
@@ -1294,6 +1380,12 @@ class _ServingMetrics:
     def idle(self, num_pages: int) -> None:
         self.slots_active.set(0)
         self.pool_pages_free.set(num_pages)
+        # the fragmentation gauge keeps its last computed value (the
+        # final retire site already reported the drained pool); a
+        # drained pool owes no tenant anything
+        if self._tenant_pages is not None:
+            for label in self._tenants_seen:
+                self._tenant_pages.set(0.0, tenant=label)
 
 
 class ContinuousBatcher:
@@ -2275,7 +2367,12 @@ class ContinuousBatcher:
                 self._metrics.slots_active.set(
                     sum(r is not None for r in req_of)
                 )
-                self._metrics.pool_pages_free.set(free_pages())
+                free_now = free_pages()
+                self._metrics.pool_pages_free.set(free_now)
+                self._metrics.pool_pressure_from(
+                    free_now, req_of, requests, total_need,
+                    self.max_pages_per_seq,
+                )
 
             if not any(r is not None for r in req_of):
                 continue
@@ -2322,7 +2419,12 @@ class ContinuousBatcher:
                     self._metrics.slots_active.set(
                         sum(r is not None for r in req_of)
                     )
-                    self._metrics.pool_pages_free.set(free_pages())
+                    free_now = free_pages()
+                    self._metrics.pool_pages_free.set(free_now)
+                    self._metrics.pool_pressure_from(
+                        free_now, req_of, requests, total_need,
+                        self.max_pages_per_seq,
+                    )
 
         # ONE host readback of ONE buffer: this tunnel charges its
         # ~65 ms d2h constant PER BUFFER, not per call — a device_get
@@ -2526,9 +2628,16 @@ class ContinuousBatcher:
                 # is async; the device drains waves behind the loop).
                 # served counters wait for the end-of-run allocator check
                 self._metrics.slots_active.set(len(wave))
-                self._metrics.pool_pages_free.set(
-                    self.num_pages
-                    - sum(pages_at(r, horizon) for _, r in wave)
+                free_now = self.num_pages - sum(
+                    pages_at(r, horizon) for _, r in wave
+                )
+                self._metrics.pool_pages_free.set(free_now)
+                self._metrics.pool_pressure_from(
+                    free_now,
+                    [rid for rid, _ in wave],
+                    {rid: r for rid, r in wave},
+                    [pages_at(r, horizon) for _, r in wave],
+                    self.max_pages_per_seq,
                 )
 
         if self._metrics:

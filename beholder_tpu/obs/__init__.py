@@ -27,12 +27,14 @@ from .flightplane import (
     split_rings,
 )
 from .recorder import DEFAULT_RING_SIZE, FlightRecorder
+from .retention import RetentionConfig, TraceVault
 from .roofline import (
     PHASE_FAMILIES,
     RooflineAttributor,
     attribution_summary,
     model_flops_per_token,
 )
+from .sentinel import Sentinel, SentinelConfig
 from .slo import (
     LatencyDigest,
     P2Quantile,
@@ -56,11 +58,15 @@ __all__ = [
     "P2Quantile",
     "PHASE_FAMILIES",
     "RequestTimeline",
+    "RetentionConfig",
     "Ring",
     "RooflineAttributor",
     "SLOConfig",
     "SLOTracker",
+    "Sentinel",
+    "SentinelConfig",
     "TimelineReport",
+    "TraceVault",
     "attribution_summary",
     "build_timelines",
     "flight_plane_from_config",
@@ -70,6 +76,8 @@ __all__ = [
     "model_flops_per_token",
     "phase_walls",
     "register_build_info",
+    "retention_from_config",
+    "sentinel_from_config",
     "slo_from_config",
     "split_rings",
 ]
@@ -129,3 +137,73 @@ def flight_recorder_from_config(config) -> FlightRecorder | None:
         attributor=attributor,
         export_path=node.get("export_path"),
     )
+
+
+def retention_from_config(config, slo=None, registry=None) -> TraceVault | None:
+    """Build the tail-based trace vault from ``instance.observability.
+    retention.*`` config, or None when disabled (the default — off,
+    serving output and the /metrics exposition stay byte-identical and
+    the ``/debug/traces`` routes 404).
+
+    Keys: ``enabled`` (bool), ``max_traces`` / ``max_bytes`` (the
+    vault bounds), ``head_sample_every`` (0 disables head sampling),
+    ``tail_quantile``, ``incident_budget``, ``export_path`` (SIGTERM
+    dump target, rotated shift-style), ``rotate_keep``. ``slo`` is the
+    live :class:`SLOTracker` (arms the slo_bad and p99_tail
+    predicates); ``registry`` arms the ``beholder_retention_*``
+    catalog.
+    """
+    node = config.get("instance.observability.retention")
+    if node is None or not node.get("enabled"):
+        return None
+    cfg = RetentionConfig(
+        max_traces=int(node.get("max_traces", RetentionConfig.max_traces)),
+        max_bytes=int(node.get("max_bytes", RetentionConfig.max_bytes)),
+        head_sample_every=int(node.get("head_sample_every", 0)),
+        tail_quantile=float(
+            node.get("tail_quantile", RetentionConfig.tail_quantile)
+        ),
+        incident_budget=int(
+            node.get("incident_budget", RetentionConfig.incident_budget)
+        ),
+        export_path=node.get("export_path"),
+        rotate_keep=int(node.get("rotate_keep", RetentionConfig.rotate_keep)),
+    )
+    return TraceVault(cfg, slo=slo, registry=registry)
+
+
+def sentinel_from_config(
+    config, slo=None, vault=None, registry=None
+) -> Sentinel | None:
+    """Build the online regression sentinel from ``instance.
+    observability.sentinel.*`` config, or None when disabled (the
+    default — off, the exposition stays byte-identical and
+    ``/debug/sentinel`` 404s).
+
+    Keys: ``enabled`` (bool), ``bucket_s``, ``fast_buckets``,
+    ``baseline_buckets``, ``growth_threshold``, ``min_rate``,
+    ``open_after`` / ``close_after`` (verdict hysteresis),
+    ``check_every``. ``slo`` arms the fast-burn incident trigger;
+    ``vault`` receives incident open/close calls; ``registry`` arms
+    the ``beholder_sentinel_*`` catalog.
+    """
+    node = config.get("instance.observability.sentinel")
+    if node is None or not node.get("enabled"):
+        return None
+    cfg = SentinelConfig(
+        bucket_s=float(node.get("bucket_s", SentinelConfig.bucket_s)),
+        fast_buckets=int(
+            node.get("fast_buckets", SentinelConfig.fast_buckets)
+        ),
+        baseline_buckets=int(
+            node.get("baseline_buckets", SentinelConfig.baseline_buckets)
+        ),
+        growth_threshold=float(
+            node.get("growth_threshold", SentinelConfig.growth_threshold)
+        ),
+        min_rate=float(node.get("min_rate", SentinelConfig.min_rate)),
+        open_after=int(node.get("open_after", SentinelConfig.open_after)),
+        close_after=int(node.get("close_after", SentinelConfig.close_after)),
+        check_every=int(node.get("check_every", SentinelConfig.check_every)),
+    )
+    return Sentinel(cfg, slo=slo, vault=vault, registry=registry)

@@ -82,22 +82,27 @@ class MergedTimeline:
 
     def jsonl(self, since: int | None = None, limit: int | None = None) -> str:
         """Merged timeline as JSON lines, led by a ``flight.plane``
-        header carrying the per-worker offsets applied and the merge
-        summary. ``since``/``limit`` cut on the merged ``seq``."""
-        head = json.dumps(
-            {
-                "name": "flight.plane",
-                "ph": "M",
-                "offsets_us": self.offsets_us,
-                **self.summary,
-            },
-            default=str,
-        )
+        header carrying the per-worker offsets applied, the merge
+        summary, and the ``next_since`` poll cursor (the merged seq a
+        poller passes back as ``?since=`` — computed after the cut so
+        it names the last seq actually served).
+        ``since``/``limit`` cut on the merged ``seq``."""
         events = self.events
         if since is not None:
             events = [e for e in events if e.get("seq", 0) > since]
         if limit is not None and limit >= 0:
             events = events[:limit]
+        next_since = events[-1].get("seq", 0) if events else (since or 0)
+        head = json.dumps(
+            {
+                "name": "flight.plane",
+                "ph": "M",
+                "offsets_us": self.offsets_us,
+                "next_since": next_since,
+                **self.summary,
+            },
+            default=str,
+        )
         return head + "\n" + "".join(
             json.dumps(event, default=str) + "\n" for event in events
         )

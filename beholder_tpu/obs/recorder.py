@@ -254,23 +254,38 @@ class FlightRecorder:
             self.dropped = 0
 
     def jsonl(
-        self, since: int | None = None, limit: int | None = None
+        self,
+        since: int | None = None,
+        limit: int | None = None,
+        cursor: bool = False,
     ) -> str:
         """The current ring serialized as JSON lines (one event per
         line) — the shared rendering behind :meth:`dump` and the live
         ``GET /debug/flight`` endpoint. When the flight plane has
         stamped ring identity a ``flight.meta`` header line leads the
-        stream (rendered here, never stored in the bounded ring)."""
+        stream (rendered here, never stored in the bounded ring).
+        ``cursor=True`` (the poll route) appends a ``flight.cursor``
+        trailer carrying ``next_since`` — the seq a poller passes back
+        as ``?since=`` — so pollers stop re-deriving it from the last
+        event; file exports stay cursor-free and byte-identical."""
         head = ""
         if self.meta is not None:
             head = json.dumps(
                 {"name": "flight.meta", "ph": "M", **self.meta},
                 default=str,
             ) + "\n"
+        events = self.events(since=since, limit=limit)
+        tail = ""
+        if cursor:
+            next_since = (
+                events[-1].get("seq", 0) if events else (since or 0)
+            )
+            tail = json.dumps(
+                {"name": "flight.cursor", "ph": "M", "next_since": next_since}
+            ) + "\n"
         return head + "".join(
-            json.dumps(event, default=str) + "\n"
-            for event in self.events(since=since, limit=limit)
-        )
+            json.dumps(event, default=str) + "\n" for event in events
+        ) + tail
 
     def dump(self, path: str | None = None) -> str:
         """Write the ring as JSON lines (one event per line) to ``path``
@@ -290,11 +305,13 @@ class FlightRecorder:
         the recorder knob is on), so an operator can inspect the
         timeline without waiting for the SIGTERM export. Accepts
         ``?since=<seq>`` + ``limit=<n>`` so a poller streams ring
-        increments instead of the whole ring each probe."""
+        increments instead of the whole ring each probe; the response
+        ends with a ``flight.cursor`` line whose ``next_since`` is the
+        value to pass back."""
 
         def flight_route(query=None):
             since, limit = parse_cursor(query)
-            body = self.jsonl(since=since, limit=limit).encode()
+            body = self.jsonl(since=since, limit=limit, cursor=True).encode()
             return 200, "application/x-ndjson", body
 
         flight_route.wants_query = True

@@ -273,6 +273,11 @@ class SLOTracker:
         self.bad = 0
         self.dropped_open = 0
         self.worst_request: dict[str, Any] = {}
+        #: tail-based retention vault (see :meth:`link_vault`): when
+        #: linked, rendered worst_request blocks carry a ``trace_ref``
+        #: naming the retained trace — resolved lazily at render time
+        #: (listener order must not decide whether the join lands)
+        self._vault = None
         self._digests: dict[str, dict[str, LatencyDigest]] = {}
         self._queue_wait = LatencyDigest()
         self._windows = {
@@ -682,7 +687,7 @@ class SLOTracker:
             "budget_remaining": round(self.budget_remaining(), 4),
             "fast_burn_threshold": cfg.fast_burn_threshold,
             "healthy": self._health()[0],
-            "worst_request": dict(self.worst_request),
+            "worst_request": self._worst_request_block(),
             "queue_wait_ms": self._queue_wait.to_dict(unit_scale=1e3),
             "scopes": {
                 scope: {
@@ -729,8 +734,27 @@ class SLOTracker:
             "ttft_p95_ms": round(digest["ttft"].quantile(0.95) * 1e3, 4),
             "tpot_p50_ms": round(digest["tpot"].quantile(0.5) * 1e3, 4),
             "attainment": round(self.attainment(), 6),
-            "worst_request": dict(self.worst_request),
+            "worst_request": self._worst_request_block(),
         }
+
+    def link_vault(self, vault) -> None:
+        """Link a tail-based retention vault (:class:`~beholder_tpu.
+        obs.retention.TraceVault`): rendered ``worst_request`` blocks
+        gain a ``trace_ref`` field naming the retained trace when the
+        vault holds one. Resolution happens at render time, not
+        observe time — the vault is a LATER recorder listener than the
+        tracker, so the retire that set worst_request has not reached
+        the vault yet when ``_observe`` runs. With no vault linked the
+        block's shape is unchanged (the retention-off pin)."""
+        self._vault = vault
+
+    def _worst_request_block(self) -> dict[str, Any]:
+        worst = dict(self.worst_request)
+        if self._vault is not None and worst:
+            ref = self._vault.trace_ref(worst.get("key"))
+            if ref is not None:
+                worst["trace_ref"] = ref
+        return worst
 
     def route(self):
         """An httpd Route rendering :meth:`snapshot` as JSON — the

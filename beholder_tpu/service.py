@@ -293,6 +293,45 @@ class BeholderService:
             # instants stream into the tracker as they are recorded
             self.flight_recorder.add_listener(self.slo.on_event)
 
+        #: optional tail-based trace retention + online regression
+        #: sentinel (``instance.observability.{retention,sentinel}.*``;
+        #: OFF by default ⇒ serving output and the default exposition
+        #: stay byte-identical, and /debug/traces + /debug/sentinel
+        #: 404). Both are flight-recorder listeners: the vault decides
+        #: keep/drop as requests retire (after the outcome is known),
+        #: the sentinel diffs fast-vs-baseline phase attribution and
+        #: opens incidents on the vault. Listener ORDER matters: the
+        #: SLO tracker folds first (the vault probes its live digests
+        #: for the p99-tail predicate), then the vault, then the
+        #: sentinel. Import-light like the other knobs.
+        from beholder_tpu.obs import (
+            retention_from_config,
+            sentinel_from_config,
+        )
+
+        self.trace_vault = retention_from_config(
+            config, slo=self.slo, registry=self.metrics.registry
+        )
+        if self.trace_vault is not None:
+            if self.flight_recorder is not None:
+                self.flight_recorder.add_listener(self.trace_vault.on_event)
+            if self.slo is not None:
+                # worst_request blocks gain trace_ref joins
+                self.slo.link_vault(self.trace_vault)
+            # histogram exemplars gain trace_ref joins (module-global:
+            # histograms predate the vault; resolution is render-time)
+            from beholder_tpu.metrics import set_exemplar_resolver
+
+            set_exemplar_resolver(self.trace_vault.trace_ref)
+        self.sentinel = sentinel_from_config(
+            config,
+            slo=self.slo,
+            vault=self.trace_vault,
+            registry=self.metrics.registry,
+        )
+        if self.sentinel is not None and self.flight_recorder is not None:
+            self.flight_recorder.add_listener(self.sentinel.on_event)
+
         #: optional batched native ingest (``instance.ingest.*``; OFF
         #: by default ⇒ the per-message wire path, handler outcomes and
         #: the default exposition stay byte-identical). Enabled, a
@@ -597,6 +636,19 @@ class BeholderService:
                 self.flight_plane.dump()
             except Exception:  # noqa: BLE001
                 pass
+        if self.trace_vault is not None:
+            if self.trace_vault.config.export_path:
+                # the kept-trace vault lands next to the flight ring,
+                # shift-rotating any previous generation
+                try:
+                    self.trace_vault.dump()
+                except Exception:  # noqa: BLE001
+                    pass
+            # the exemplar join is module-global; un-install it so a
+            # later vault-less service renders the pinned off-shape
+            from beholder_tpu.metrics import set_exemplar_resolver
+
+            set_exemplar_resolver(None)
         self.metrics.close()
         self.db.close()
 
@@ -919,6 +971,22 @@ def init(
             # timeline (same ?since=/limit poll cursor as /debug/flight)
             metrics.add_route(
                 "/debug/cluster-flight", service.flight_plane.route()
+            )
+        if service.trace_vault is not None:
+            # GET /debug/traces: the tail-based vault index;
+            # GET /debug/traces/<id>: one kept trace as Perfetto JSON
+            # (prefix route — the trailing "/" key + wants_path)
+            metrics.add_route(
+                "/debug/traces", service.trace_vault.index_route()
+            )
+            metrics.add_route(
+                "/debug/traces/", service.trace_vault.trace_route()
+            )
+        if service.sentinel is not None:
+            # GET /debug/sentinel: the live regression verdict + the
+            # ranked fast-vs-baseline attribution behind it
+            metrics.add_route(
+                "/debug/sentinel", service.sentinel.route()
             )
 
         #: optional /healthz + /readyz endpoint (extension; the reference

@@ -448,7 +448,12 @@ def _run_spec_loop(
             batcher._metrics.slots_active.set(
                 sum(r is not None for r in req_of)
             )
-            batcher._metrics.pool_pages_free.set(free_pages())
+            free_now = free_pages()
+            batcher._metrics.pool_pages_free.set(free_now)
+            batcher._metrics.pool_pressure_from(
+                free_now, req_of, requests, total_need,
+                batcher.max_pages_per_seq,
+            )
         if not any(r is not None for r in req_of):
             continue
 
@@ -603,7 +608,12 @@ def _run_spec_loop(
                 batcher._metrics.slots_active.set(
                     sum(r is not None for r in req_of)
                 )
-                batcher._metrics.pool_pages_free.set(free_pages())
+                free_now = free_pages()
+                batcher._metrics.pool_pages_free.set(free_now)
+                batcher._metrics.pool_pressure_from(
+                    free_now, req_of, requests, total_need,
+                    batcher.max_pages_per_seq,
+                )
 
     # no trailing allocator check in EITHER mode: every ALLOCATING
     # dispatch (admit, dense verify, the fused round's in-program

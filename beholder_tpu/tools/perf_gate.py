@@ -41,6 +41,10 @@ ratio``                     controlled / uncontrolled victim p95 on   higher
 ``control_tail_fairness_
 ratio``                     victim p95 / flood p95 under control —    higher
                             both tenants ride the same rounds
+``retention_overhead_
+ratio``                     vault-armed / plain serving wall,         higher
+                            slope-timed interleaved in the same
+                            session — host speed divides out
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -143,6 +147,14 @@ NOISE_BANDS: dict[str, float] = {
     # tail must sit well under the flood's; degradation = the victim's
     # tail inflating toward the flood's. Same tail-width band
     "control_tail_fairness_ratio": 0.75,
+    # vault-armed / plain serving wall (schema v13): both passes
+    # slope-timed interleaved in the same session, so host drift
+    # divides out — what the band must catch is always-on retention
+    # stopping being cheap enough to leave on (the listener fold or
+    # the keep-path assembly leaking into the serving wall), not
+    # scheduler jitter around ~1x. Same interleaved-ratio width as
+    # fused_verify_ratio
+    "retention_overhead_ratio": 0.40,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -261,6 +273,13 @@ def _control_tail_fairness(artifact: dict) -> float | None:
     return float(value)
 
 
+def _retention_overhead(artifact: dict) -> float | None:
+    value = _get(artifact, "retention", "overhead_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v13 artifact / retention scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -292,6 +311,9 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # victim/flood tail under control: fairness eroding shows as the
     # victim's tail RISING toward the flood's
     ("control_tail_fairness_ratio", _control_tail_fairness, "higher"),
+    # vault-armed/plain serving wall: a retention-cost regression shows
+    # as the ratio RISING away from "cheap enough to leave on"
+    ("retention_overhead_ratio", _retention_overhead, "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -365,6 +387,20 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "control_scale_events",
         lambda a: _get(a, "control", "scale_events"),
+    ),
+    # retention evidence behind retention_overhead_ratio: keep rate and
+    # kept-trace counts are policy/workload-dependent, reported only
+    (
+        "retention_kept_traces",
+        lambda a: _get(a, "retention", "kept"),
+    ),
+    (
+        "retention_keep_rate",
+        lambda a: _get(a, "retention", "keep_rate"),
+    ),
+    (
+        "retention_incidents",
+        lambda a: _get(a, "retention", "incidents"),
     ),
 ]
 

@@ -427,11 +427,30 @@ def test_flight_route_since_limit_cursor():
     code, ctype, body = route({"since": ["4"], "limit": ["3"]})
     assert (code, ctype) == (200, "application/x-ndjson")
     lines = [json.loads(x) for x in body.decode().splitlines() if x]
-    assert [e["seq"] for e in lines] == [5, 6, 7]
+    events = [e for e in lines if e.get("ph") != "M"]
+    assert [e["seq"] for e in events] == [5, 6, 7]
+    # the response's flight.cursor trailer hands pollers the resume
+    # point explicitly — next poll is ?since=<next_since>, no client-
+    # side max() over event seqs needed
+    assert lines[-1]["name"] == "flight.cursor"
+    assert lines[-1]["next_since"] == 7
     # the seq is monotone across the recorder's whole life, so the
     # cursor still advances past ring wrap
-    full = [json.loads(x) for x in route({})[2].decode().splitlines()]
-    assert [e["seq"] for e in full] == list(range(1, 11))
+    full = [
+        json.loads(x) for x in route({})[2].decode().splitlines()
+    ]
+    full_events = [e for e in full if e.get("ph") != "M"]
+    assert [e["seq"] for e in full_events] == list(range(1, 11))
+    assert full[-1]["next_since"] == 10
+    # an empty window hands back the caller's own cursor — polling an
+    # idle recorder never rewinds
+    empty = [
+        json.loads(x)
+        for x in route({"since": ["10"]})[2].decode().splitlines()
+        if x
+    ]
+    assert [e["name"] for e in empty] == ["flight.cursor"]
+    assert empty[0]["next_since"] == 10
 
 
 def test_cluster_flight_route_cursor_and_header():
@@ -449,6 +468,12 @@ def test_cluster_flight_route_cursor_and_header():
     assert lines[0]["name"] == "flight.plane"
     assert "offsets_us" in lines[0] and lines[0]["workers"] == 1.0
     assert [e["seq"] for e in lines[1:]] == [3, 4]
+    # the header carries the poll cursor: resume at ?since=<next_since>
+    assert lines[0]["next_since"] == 4
+    empty = json.loads(
+        route({"since": ["6"]})[2].decode().splitlines()[0]
+    )
+    assert empty["name"] == "flight.plane" and empty["next_since"] == 6
 
 
 # -- drop pressure + build-info series ---------------------------------------

@@ -1,0 +1,781 @@
+"""Tail-based trace retention + the online regression sentinel
+(ISSUE 16): keep/drop predicates decided at retirement, vault
+count/byte bounds and shift-rotated dumps, the /debug/traces routes
+(incl. the httpd prefix dispatch), sentinel verdicts with open/close
+hysteresis, incident-scoped capture (verdict- and burn-triggered),
+the trace_ref joins (SLO worst_request + histogram exemplars), the
+default-OFF byte-identical pins for BOTH knobs, the serving pool
+fragmentation/tenant gauges, artifact schema v13, and the
+retention_overhead_ratio perf-gate band."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.metrics import (
+    Histogram,
+    Metrics,
+    set_exemplar_resolver,
+)
+from beholder_tpu.obs import (
+    FlightRecorder,
+    RetentionConfig,
+    Sentinel,
+    SentinelConfig,
+    SLOConfig,
+    SLOTracker,
+    TraceVault,
+    retention_from_config,
+    sentinel_from_config,
+)
+
+pytestmark = pytest.mark.sentinel
+
+US = 1_000_000
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+def _request(seed, t=9, horizon=6, tenant=None):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+        tenant=tenant,
+    )
+
+
+BATCHER_KW = dict(
+    num_pages=16, page_size=8, slots=2, max_prefix=16, max_pages_per_seq=4
+)
+
+
+def _mk_batcher(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ContinuousBatcher(model, state.params, **kw)
+
+
+# synthetic request lifecycles: gid-keyed, one trace per request (the
+# single-engine contract — every scheduler call opens its own trace)
+
+
+def _claim(key, ts_us, trace, slot=0, **extra):
+    return {
+        "name": "req.claim", "ph": "i", "ts_us": ts_us,
+        "trace_id": trace, "args": {"gid": key, "slot": slot, **extra},
+    }
+
+
+def _admit(ts_us, dur_us, trace, slot=0):
+    return {
+        "name": "admit", "ph": "X", "ts_us": ts_us, "dur_us": dur_us,
+        "trace_id": trace, "args": {"slot": slot},
+    }
+
+
+def _retire(key, ts_us, trace, outcome="ok", tokens=4):
+    return {
+        "name": "req.retire", "ph": "i", "ts_us": ts_us,
+        "trace_id": trace, "args": {
+            "gid": key, "tokens": tokens, "outcome": outcome,
+        },
+    }
+
+
+def _feed(vault, key, ttft_us=100_000, outcome="ok", start_us=0):
+    """One healthy-shaped lifecycle: claim -> admit round -> retire."""
+    trace = f"tr-{key}"
+    vault.on_event(_claim(key, start_us, trace))
+    vault.on_event(_admit(start_us, ttft_us, trace))
+    vault.on_event(
+        _retire(key, start_us + ttft_us + 50_000, trace, outcome)
+    )
+    return trace
+
+
+def _slice(name, bucket, dur_s, worker="w1"):
+    return {
+        "name": name, "ph": "X", "ts_us": bucket * US + 1,
+        "dur_us": dur_s * US, "args": {"worker": worker},
+    }
+
+
+# -- keep predicates ---------------------------------------------------------
+
+
+def test_healthy_request_is_dropped():
+    tracker = SLOTracker(SLOConfig(ttft_ms=30_000.0, tpot_ms=10_000.0))
+    vault = TraceVault(RetentionConfig(), slo=tracker)
+    _feed(vault, "g-ok")
+    assert vault.evaluated == 1 and vault.kept == 0
+    assert vault.index()["traces"] == []
+
+
+def test_keep_on_bad_outcomes():
+    vault = TraceVault(RetentionConfig())
+    _feed(vault, "g-p", outcome="Preempted")
+    _feed(vault, "g-d", outcome="Dropped", start_us=5 * US)
+    _feed(vault, "g-x", outcome="deadline_exceeded", start_us=10 * US)
+    traces = vault.index()["traces"]
+    assert [t["reasons"] for t in traces] == [
+        ["outcome:Preempted"],
+        ["outcome:Dropped"],
+        ["outcome:deadline_exceeded"],
+    ]
+    assert [t["outcome"] for t in traces] == [
+        "Preempted", "Dropped", "deadline_exceeded",
+    ]
+
+
+def test_keep_on_req_dropped_instant():
+    """The failover layer's req.dropped has no outcome arg — the
+    instant itself means dropped."""
+    vault = TraceVault(RetentionConfig())
+    vault.on_event(_claim("g-lost", 0, "tr-lost"))
+    vault.on_event({
+        "name": "req.dropped", "ph": "i", "ts_us": 2 * US,
+        "trace_id": "tr-lost",
+        "args": {"gid": "g-lost", "reason": "recovery_limit"},
+    })
+    (kept,) = vault.index()["traces"]
+    assert kept["outcome"] == "dropped"
+    assert "outcome:dropped" in kept["reasons"]
+
+
+def test_keep_on_slo_violation():
+    tracker = SLOTracker(SLOConfig(ttft_ms=50.0, tpot_ms=10_000.0))
+    vault = TraceVault(RetentionConfig(), slo=tracker)
+    _feed(vault, "g-slow", ttft_us=100_000)  # 100ms > 50ms objective
+    (kept,) = vault.index()["traces"]
+    assert kept["reasons"] == ["slo_bad"]
+    assert kept["timeline"]["ttft_s"] == pytest.approx(0.1)
+
+
+def test_keep_on_recovery_leg():
+    vault = TraceVault(RetentionConfig())
+    vault.on_event(_claim("g-rec", 0, "tr-rec"))
+    vault.on_event({
+        "name": "req.recovered", "ph": "i", "ts_us": 1 * US,
+        "trace_id": "tr-rec",
+        "args": {"gid": "g-rec", "worker": "decode-1", "reason": "kill"},
+    })
+    vault.on_event(_claim("g-rec", 2 * US, "tr-rec2"))
+    vault.on_event(_admit(2 * US, 100_000, "tr-rec2"))
+    vault.on_event(_retire("g-rec", 3 * US, "tr-rec2"))
+    (kept,) = vault.index()["traces"]
+    assert "recovery" in kept["reasons"]
+    assert kept["timeline"]["recovered"] is True
+    assert kept["timeline"]["legs"] == 2
+
+
+def test_keep_on_p99_tail_probes_digests_read_only():
+    tracker = SLOTracker(SLOConfig(ttft_ms=30_000.0, tpot_ms=10_000.0))
+    for i in range(20):
+        tracker.observe(ttft_s=0.01, key=i)
+    vault = TraceVault(
+        RetentionConfig(tail_quantile=0.9), slo=tracker
+    )
+    scopes_before = set(tracker._digests)
+    _feed(vault, "g-tail", ttft_us=1_000_000)  # 1s >> the 10ms crowd
+    (kept,) = vault.index()["traces"]
+    assert kept["reasons"] == ["p99_tail"]
+    # the vault never creates digest scopes (READ-ONLY probe)
+    assert set(tracker._digests) == scopes_before
+
+
+def test_p99_tail_abstains_below_min_count():
+    tracker = SLOTracker(SLOConfig(ttft_ms=30_000.0, tpot_ms=10_000.0))
+    for i in range(5):  # below MIN_TAIL_COUNT
+        tracker.observe(ttft_s=0.01, key=i)
+    vault = TraceVault(RetentionConfig(tail_quantile=0.9), slo=tracker)
+    _feed(vault, "g-few", ttft_us=1_000_000)
+    assert vault.kept == 0
+
+
+def test_head_sample_keeps_every_nth():
+    vault = TraceVault(RetentionConfig(head_sample_every=2))
+    for i in range(4):
+        _feed(vault, f"g-{i}", start_us=i * US)
+    traces = vault.index()["traces"]
+    assert [t["key"] for t in traces] == ["g-1", "g-3"]
+    assert all(t["reasons"] == ["head_sample"] for t in traces)
+    assert vault.evaluated == 4 and vault.kept == 2
+
+
+# -- vault bounds + metrics --------------------------------------------------
+
+
+def test_vault_count_bound_evicts_oldest():
+    vault = TraceVault(
+        RetentionConfig(max_traces=2, head_sample_every=1)
+    )
+    for i in range(5):
+        _feed(vault, f"g-{i}", start_us=i * US)
+    index = vault.index()
+    assert index["resident"] == 2 and index["evicted"] == 3
+    assert [t["key"] for t in index["traces"]] == ["g-3", "g-4"]
+    # lookups follow eviction: an evicted key no longer resolves
+    assert vault.trace_ref("g-0") is None
+    assert vault.trace_ref("g-4") is not None
+    assert vault.get(vault.trace_ref("g-4")) is not None
+
+
+def test_vault_byte_bound_and_oversized_guard():
+    vault = TraceVault(
+        RetentionConfig(max_bytes=1000, head_sample_every=1)
+    )
+    for i in range(6):
+        _feed(vault, f"g-{i}", start_us=i * US)
+    assert vault.bytes <= 1000
+    assert 0 < vault.index()["resident"] < 6
+    # a single trace bigger than the bound stays resident (an empty
+    # vault serves no one)
+    tiny = TraceVault(RetentionConfig(max_bytes=10, head_sample_every=1))
+    _feed(tiny, "g-big")
+    assert tiny.index()["resident"] == 1 and tiny.bytes > 10
+
+
+def test_vault_metrics_lazy_and_counted():
+    m = Metrics()
+    assert "beholder_retention" not in m.registry.render()
+    vault = TraceVault(
+        RetentionConfig(head_sample_every=1), registry=m.registry
+    )
+    _feed(vault, "g-0")
+    text = m.registry.render()
+    assert "beholder_retention_evaluated_total 1" in text
+    assert (
+        'beholder_retention_kept_total{reason="head_sample"} 1' in text
+    )
+    assert "beholder_retention_vault_traces 1" in text
+
+
+# -- incident-scoped capture -------------------------------------------------
+
+
+def test_incident_keeps_everything_up_to_budget():
+    vault = TraceVault(RetentionConfig(incident_budget=2))
+    incident = vault.open_incident("test: manual")
+    assert incident["id"] == "inc-1"
+    # idempotent while open
+    assert vault.open_incident("another")["id"] == "inc-1"
+    for i in range(3):
+        _feed(vault, f"g-{i}", start_us=i * US)
+    traces = vault.index()["traces"]
+    assert len(traces) == 2  # budget-bounded keep-everything
+    assert all(t["reasons"][0] == "incident" for t in traces)
+    assert all(t["incident"] == "inc-1" for t in traces)
+    assert vault.incident["kept"] == 2
+    assert vault.incident["trace_ids"] == [t["id"] for t in traces]
+    closed = vault.close_incident()
+    assert closed["id"] == "inc-1" and "closed_unix_s" in closed
+    assert vault.incident is None
+    assert vault.index()["incidents"][0]["id"] == "inc-1"
+    # budget resets per incident
+    assert vault.open_incident("again")["id"] == "inc-2"
+
+
+# -- export + rotation -------------------------------------------------------
+
+
+def test_dump_writes_header_and_rotates_shift_style(tmp_path):
+    path = str(tmp_path / "vault.jsonl")
+    vault = TraceVault(
+        RetentionConfig(
+            head_sample_every=1, export_path=path, rotate_keep=2
+        )
+    )
+    for gen in range(4):
+        _feed(vault, f"g-{gen}", start_us=gen * US)
+        assert vault.dump() == path
+    lines = [json.loads(x) for x in open(path)]
+    assert lines[0]["name"] == "trace.vault"
+    assert lines[0]["kept"] == 4
+    # one line per resident trace, each with summary + raw events
+    assert len(lines) == 1 + vault.index()["resident"]
+    assert lines[1]["summary"]["id"] and lines[1]["events"]
+    # shift rotation: .1 is the previous dump, .2 the one before; a
+    # third generation never exists at rotate_keep=2
+    prev = [json.loads(x) for x in open(path + ".1")]
+    assert prev[0]["kept"] == 3
+    assert (tmp_path / "vault.jsonl.2").exists()
+    assert not (tmp_path / "vault.jsonl.3").exists()
+    with pytest.raises(ValueError, match="export_path"):
+        TraceVault(RetentionConfig()).dump()
+
+
+# -- routes (incl. the httpd prefix dispatch) --------------------------------
+
+
+def test_trace_routes_serve_index_and_perfetto_detail():
+    vault = TraceVault(RetentionConfig(head_sample_every=1))
+    _feed(vault, "g-0")
+    vault_id = vault.trace_ref("g-0")
+    metrics = Metrics()
+    metrics.add_route("/debug/traces", vault.index_route())
+    metrics.add_route("/debug/traces/", vault.trace_route())
+    port = metrics.expose(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces"
+        ) as resp:
+            index = json.loads(resp.read())
+        assert index["schema"] == "beholder-trace-vault"
+        assert index["traces"][0]["id"] == vault_id
+        # the prefix route hands the id through as the subpath and
+        # serves Chrome trace-event JSON (Perfetto-loadable)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces/{vault_id}"
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["traceEvents"]
+        assert doc["vault"]["id"] == vault_id
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/traces/nope"
+            )
+        assert err.value.code == 404
+        # the debug routes never touch the exposition
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            assert resp.read().decode() == metrics.registry.render()
+    finally:
+        metrics.close()
+
+
+def test_debug_routes_absent_by_default():
+    metrics = Metrics()
+    port = metrics.expose(0)
+    try:
+        for path in ("/debug/traces", "/debug/traces/x", "/debug/sentinel"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}{path}")
+            assert err.value.code == 404
+    finally:
+        metrics.close()
+
+
+# -- the sentinel ------------------------------------------------------------
+
+
+def _mk_sentinel(**kw):
+    cfg = dict(
+        bucket_s=1.0, fast_buckets=1, baseline_buckets=4,
+        growth_threshold=1.5, min_rate=1e-9,
+        open_after=2, close_after=2, check_every=10**9,
+    )
+    cfg.update(kw)
+    return SentinelConfig(**cfg)
+
+
+def test_sentinel_verdict_hysteresis_and_incident_lifecycle():
+    """The acceptance leg: an injected phase slowdown breaches with a
+    verdict naming the right phase@worker, open_after breaches open
+    the incident on the vault, close_after clean checks close both."""
+    vault = TraceVault(RetentionConfig())
+    sentinel = Sentinel(_mk_sentinel(), vault=vault)
+    for b in range(4):
+        sentinel.on_event(_slice("decode_step", b, 0.1))
+        sentinel.on_event(_slice("tick", b, 0.05))
+    sentinel.on_event(_slice("decode_step", 4, 0.8))  # 8x regression
+    sentinel.on_event(_slice("tick", 4, 0.05))
+
+    first = sentinel.check()
+    assert first["breach"] is True
+    assert first["ratio"] == pytest.approx(8.0)
+    assert "decode_step" in first["verdict"] and "w1" in first["verdict"]
+    assert first["top"]["phase"] == "decode_step"
+    # hysteresis: one breaching check neither pages nor captures
+    assert sentinel.active is None and vault.incident is None
+    assert sentinel.health()[0] is True
+
+    second = sentinel.check()
+    assert second["breach"] is True
+    assert sentinel.active is not None
+    assert sentinel.active["incident"] == "inc-1"
+    assert vault.incident["reason"].startswith("sentinel:")
+    assert vault.incident["explanation"]["ranked"]
+    healthy, detail = sentinel.health()
+    assert healthy is False and "decode_step" in detail
+
+    # recovery: a clean fast bucket, then close_after clean checks
+    sentinel.on_event(_slice("decode_step", 5, 0.1))
+    sentinel.on_event(_slice("tick", 5, 0.05))
+    assert sentinel.check()["breach"] is False
+    assert sentinel.active is not None  # one clean check is not enough
+    assert sentinel.check()["breach"] is False
+    assert sentinel.active is None
+    assert vault.incident is None
+    assert vault.index()["incidents"][0]["id"] == "inc-1"
+    assert sentinel.health()[0] is True
+
+    snap = sentinel.snapshot()
+    assert snap["schema"] == "beholder-sentinel"
+    assert snap["checks"] == 4 and snap["breaches"] == 2
+    code, ctype, body = sentinel.route()()
+    assert code == 200 and json.loads(body) == snap
+
+
+def test_sentinel_needs_baseline_coverage():
+    sentinel = Sentinel(_mk_sentinel())
+    assert sentinel.check() is None  # no buckets at all
+    sentinel.on_event(_slice("tick", 0, 0.1))
+    assert sentinel.check() is None  # fast window only, no baseline
+    assert sentinel.checks == 2
+
+
+def test_sentinel_min_rate_floor_gates_idle_noise():
+    sentinel = Sentinel(_mk_sentinel(min_rate=0.5))
+    for b in range(4):
+        sentinel.on_event(_slice("tick", b, 0.01))
+    sentinel.on_event(_slice("tick", 4, 0.08))  # 8x but tiny
+    check = sentinel.check()
+    assert check["ratio"] == pytest.approx(8.0)
+    assert check["breach"] is False  # under the absolute floor
+
+
+def test_sentinel_check_every_cadence_runs_inline():
+    sentinel = Sentinel(_mk_sentinel(check_every=10, open_after=1))
+    for b in range(4):
+        for _ in range(2):
+            sentinel.on_event(_slice("decode_step", b, 0.1))
+    sentinel.on_event(_slice("decode_step", 4, 0.8))
+    sentinel.on_event(_slice("decode_step", 4, 0.8))  # 10th event
+    assert sentinel.checks >= 1
+    assert sentinel.last_check is not None
+
+
+def test_sentinel_metrics_lazy_and_updated():
+    m = Metrics()
+    assert "beholder_sentinel" not in m.registry.render()
+    sentinel = Sentinel(
+        _mk_sentinel(open_after=1), registry=m.registry
+    )
+    for b in range(4):
+        sentinel.on_event(_slice("decode_step", b, 0.1))
+    sentinel.on_event(_slice("decode_step", 4, 0.8))
+    sentinel.check()
+    text = m.registry.render()
+    assert "beholder_sentinel_checks_total 1" in text
+    assert "beholder_sentinel_breaches_total 1" in text
+    assert "beholder_sentinel_active 1" in text
+    assert "beholder_sentinel_regression_ratio 8" in text
+
+
+def test_fast_burn_breach_opens_and_closes_incident():
+    clock = [100.0]
+    tracker = SLOTracker(
+        SLOConfig(ttft_ms=1e-3, target=0.99, fast_burn_threshold=2.0),
+        clock=lambda: clock[0],
+    )
+    for i in range(5):
+        tracker.observe(ttft_s=1.0, key=i)  # every request violates
+    assert tracker.burn_rate("fast") > 2.0
+    vault = TraceVault(RetentionConfig())
+    sentinel = Sentinel(_mk_sentinel(), slo=tracker, vault=vault)
+    sentinel.on_event(_slice("tick", 0, 0.1))
+    sentinel.on_event(_slice("tick", 1, 0.1))
+    sentinel.check()
+    assert vault.incident is not None
+    assert vault.incident["reason"].startswith("fast burn")
+    assert sentinel.snapshot()["burn_incident"] is True
+    # the burn subsides (the fast window rolls past the violations)
+    clock[0] += 3600.0
+    sentinel.check()
+    assert vault.incident is None
+    assert sentinel.snapshot()["burn_incident"] is False
+
+
+def test_sentinel_healthz_leg_beside_burn_check():
+    from beholder_tpu.health import HealthServer, add_sentinel_check
+
+    vault = TraceVault(RetentionConfig())
+    sentinel = Sentinel(_mk_sentinel(open_after=1), vault=vault)
+    server = HealthServer(port=0)
+    add_sentinel_check(server, lambda: sentinel)
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz"
+        ) as resp:
+            assert json.loads(resp.read())["checks"]["sentinel"]["ok"]
+        for b in range(4):
+            sentinel.on_event(_slice("decode_step", b, 0.1))
+        sentinel.on_event(_slice("decode_step", 4, 0.8))
+        sentinel.check()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+        assert err.value.code == 503
+        body = json.loads(err.value.read())
+        assert "decode_step" in body["checks"]["sentinel"]["detail"]
+    finally:
+        server.close()
+
+
+# -- the trace_ref joins -----------------------------------------------------
+
+
+def test_worst_request_links_to_retained_trace():
+    tracker = SLOTracker(SLOConfig(ttft_ms=50.0, tpot_ms=10_000.0))
+    vault = TraceVault(RetentionConfig(), slo=tracker)
+    tracker.link_vault(vault)
+    # the daemon listener order: tracker first, vault second
+    trace = f"tr-g-bad"
+    for event in (
+        _claim("g-bad", 0, trace),
+        _admit(0, 100_000, trace),
+        _retire("g-bad", 200_000, trace),
+    ):
+        tracker.on_event(event)
+        vault.on_event(event)
+    worst = tracker.snapshot()["worst_request"]
+    assert worst["key"] == "g-bad"
+    assert worst["trace_ref"] == vault.trace_ref("g-bad")
+    assert worst["trace_ref"] in {
+        t["id"] for t in vault.index()["traces"]
+    }
+    # shape pin: no vault linked (retention off) -> no trace_ref key
+    bare = SLOTracker(SLOConfig(ttft_ms=50.0, tpot_ms=10_000.0))
+    bare.observe(ttft_s=1.0, key="g-bad")
+    assert "trace_ref" not in bare.snapshot()["worst_request"]
+
+
+def test_histogram_exemplars_gain_trace_ref_when_vault_armed():
+    vault = TraceVault(RetentionConfig(head_sample_every=1))
+    trace = _feed(vault, "g-ex")
+    h = Histogram("retention_ex_seconds", "x", buckets=[0.1, 1.0])
+    h.observe(0.05, exemplar_trace_id=trace)
+    h.observe(0.05, exemplar_trace_id="unretained")
+    # resolver unset (retention off): the pinned shape, no trace_ref
+    assert "trace_ref" not in h.exemplars()["0.1"]
+    set_exemplar_resolver(vault.trace_ref)
+    try:
+        h2 = Histogram("retention_ex2_seconds", "x", buckets=[0.1])
+        h2.observe(0.05, exemplar_trace_id=trace)
+        ex = h2.exemplars()["0.1"]
+        assert ex["trace_ref"] == vault.trace_ref(trace)
+        # an unretained trace id resolves to nothing -> field absent
+        h3 = Histogram("retention_ex3_seconds", "x", buckets=[0.1])
+        h3.observe(0.05, exemplar_trace_id="unretained")
+        assert "trace_ref" not in h3.exemplars()["0.1"]
+    finally:
+        set_exemplar_resolver(None)
+    assert "trace_ref" not in h.exemplars()["0.1"]
+
+
+# -- default OFF: byte-identical serving + exposition (both knobs) -----------
+
+
+def test_both_knobs_off_build_nothing():
+    for config in (
+        ConfigNode({}),
+        ConfigNode({"instance": {"observability": {
+            "retention": {"enabled": False},
+            "sentinel": {"enabled": False},
+        }}}),
+    ):
+        assert retention_from_config(config) is None
+        assert sentinel_from_config(config) is None
+    text = Metrics().registry.render()
+    assert "beholder_retention" not in text
+    assert "beholder_sentinel" not in text
+
+
+def test_armed_listeners_leave_serving_bitwise_identical(model_state):
+    """The tentpole parity pin: the vault + sentinel only OBSERVE —
+    attaching both as recorder listeners changes no served byte, and
+    the extra exposition series are retention/sentinel-only."""
+    model, state = model_state
+    plain_metrics = Metrics()
+    plain = _mk_batcher(model, state, metrics=plain_metrics)
+    base = plain.run([_request(i, horizon=5) for i in range(3)])
+
+    armed_metrics = Metrics()
+    fr = FlightRecorder(ring_size=512)
+    vault = TraceVault(
+        RetentionConfig(head_sample_every=1),
+        registry=armed_metrics.registry,
+    )
+    sentinel = Sentinel(_mk_sentinel(), registry=armed_metrics.registry)
+    fr.add_listener(vault.on_event)
+    fr.add_listener(sentinel.on_event)
+    armed = _mk_batcher(
+        model, state, metrics=armed_metrics, flight_recorder=fr
+    )
+    got = armed.run([_request(i, horizon=5) for i in range(3)])
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert vault.evaluated == 3 and vault.kept == 3
+    names = lambda m: {x.name for x in m.registry._metrics}  # noqa: E731
+    extra = names(armed_metrics) - names(plain_metrics)
+    assert extra and all(
+        n.startswith(("beholder_retention", "beholder_sentinel"))
+        for n in extra
+    )
+
+
+def test_from_config_knobs_parse():
+    vault = retention_from_config(
+        ConfigNode({"instance": {"observability": {"retention": {
+            "enabled": True, "max_traces": 7, "max_bytes": 4096,
+            "head_sample_every": 3, "tail_quantile": 0.9,
+            "incident_budget": 5, "export_path": "/tmp/v.jsonl",
+            "rotate_keep": 2,
+        }}}})
+    )
+    assert vault is not None
+    cfg = vault.config
+    assert cfg.max_traces == 7 and cfg.max_bytes == 4096
+    assert cfg.head_sample_every == 3 and cfg.tail_quantile == 0.9
+    assert cfg.incident_budget == 5 and cfg.rotate_keep == 2
+    assert cfg.export_path == "/tmp/v.jsonl"
+
+    sentinel = sentinel_from_config(
+        ConfigNode({"instance": {"observability": {"sentinel": {
+            "enabled": True, "bucket_s": 2.0, "fast_buckets": 2,
+            "baseline_buckets": 8, "growth_threshold": 2.5,
+            "min_rate": 0.1, "open_after": 1, "close_after": 4,
+            "check_every": 64,
+        }}}})
+    )
+    assert sentinel is not None
+    scfg = sentinel.config
+    assert scfg.bucket_s == 2.0 and scfg.fast_buckets == 2
+    assert scfg.baseline_buckets == 8 and scfg.growth_threshold == 2.5
+    assert scfg.min_rate == 0.1
+    assert scfg.open_after == 1 and scfg.close_after == 4
+    assert scfg.check_every == 64
+
+    with pytest.raises(ValueError, match="max_traces"):
+        RetentionConfig(max_traces=0)
+    with pytest.raises(ValueError, match="tail_quantile"):
+        RetentionConfig(tail_quantile=1.5)
+    with pytest.raises(ValueError, match="growth_threshold"):
+        SentinelConfig(growth_threshold=1.0)
+    with pytest.raises(ValueError, match="bucket_s"):
+        SentinelConfig(bucket_s=0.0)
+
+
+# -- satellite: serving pool fragmentation + tenant gauges -------------------
+
+
+def test_pool_fragmentation_gauge_registers_lazily(model_state):
+    model, state = model_state
+    m = Metrics()
+    batcher = _mk_batcher(model, state, metrics=m)
+    batcher.run([_request(i, horizon=4) for i in range(2)])
+    text = m.registry.render()
+    # drained pool: 16 free pages, one slot's claim capped at
+    # max_pages_per_seq=4 -> 4/16
+    assert "beholder_serving_pool_fragmentation 0.25" in text
+    # an untenanted run never registers the tenant family
+    assert "beholder_serving_tenant_committed_pages" not in text
+
+
+def test_tenant_committed_pages_gauge(model_state):
+    model, state = model_state
+    m = Metrics()
+    batcher = _mk_batcher(model, state, metrics=m)
+    batcher.run([
+        _request(0, horizon=4, tenant="acme"),
+        _request(1, horizon=4),
+    ])
+    text = m.registry.render()
+    # registered by the tenanted commit; drained back to zero at retire
+    assert (
+        'beholder_serving_tenant_committed_pages{tenant="acme"} 0'
+        in text
+    )
+
+
+# -- artifact schema v13 + the perf-gate band --------------------------------
+
+
+def test_artifact_v13_retention_block_roundtrip(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_test")
+    assert rec.retention == artifact.EMPTY_RETENTION
+    rec.record_retention({
+        "kept": 9.0, "evaluated": 48.0, "keep_rate": 0.1875,
+        "overhead_ratio": 1.02, "incidents": 1.0,
+    })
+    path = rec.write(str(tmp_path / "a.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema_version"] >= 13
+    assert obj["retention"]["kept"] == 9.0
+    assert obj["retention"]["overhead_ratio"] == 1.02
+
+
+def test_artifact_v13_rejects_missing_keys():
+    rec = artifact.ArtifactRecorder("bench_test")
+    with pytest.raises(ValueError, match="retention summary missing"):
+        rec.record_retention({"kept": 1.0, "evaluated": 2.0})
+    assert rec.retention == artifact.EMPTY_RETENTION
+
+
+def _gate_artifact(overhead=1.05, kept=12.0):
+    rec = artifact.ArtifactRecorder("bench_gate")
+    rec.record_raw("x", "trial_wall", [0.1])
+    rec.record_retention({
+        "kept": kept, "evaluated": 48.0, "keep_rate": 0.25,
+        "overhead_ratio": overhead, "incidents": 1.0,
+    })
+    return rec.to_dict()
+
+
+def test_perf_gate_bands_retention_overhead():
+    from beholder_tpu.tools import perf_gate
+
+    base = _gate_artifact()
+    verdict = perf_gate.run_gate(base, _gate_artifact())
+    assert verdict["verdict"] == "pass"
+    assert "retention_overhead_ratio" in {
+        c["metric"] for c in verdict["checks"]
+    }
+    # the vault growing serving wall beyond the band -> fail
+    verdict = perf_gate.run_gate(base, _gate_artifact(overhead=1.6))
+    assert "retention_overhead_ratio" in verdict["failed"]
+    # getting cheaper is never a failure (higher-fails, one-sided)
+    assert perf_gate.run_gate(
+        base, _gate_artifact(overhead=0.7)
+    )["verdict"] == "pass"
+    # keep rate / kept count are reported absolute, never gated
+    reported = perf_gate.run_gate(base, _gate_artifact())[
+        "reported_not_gated"
+    ]
+    assert reported["retention_kept_traces"]["current"] == 12.0
+    # a retention-less artifact skips, never fails
+    rec = artifact.ArtifactRecorder("bench_noret")
+    rec.record_raw("x", "trial_wall", [0.1])
+    empty = rec.to_dict()
+    verdict = perf_gate.run_gate(empty, empty)
+    assert verdict["verdict"] == "pass"
+    assert "retention_overhead_ratio" in {
+        s["metric"] for s in verdict["skipped"]
+    }

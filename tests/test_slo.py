@@ -726,7 +726,12 @@ def test_debug_flight_route_serves_live_ring():
     assert code == 200
     assert ctype == "application/x-ndjson"
     lines = [json.loads(x) for x in body.decode().splitlines()]
-    assert [e["name"] for e in lines] == ["req.claim", "tick"]
+    # the route appends a flight.cursor trailer (ph == "M") carrying
+    # next_since for pollers; the events themselves are unchanged
+    events = [e for e in lines if e.get("ph") != "M"]
+    assert [e["name"] for e in events] == ["req.claim", "tick"]
+    assert lines[-1]["name"] == "flight.cursor"
+    assert lines[-1]["next_since"] == events[-1]["seq"]
     # and it rides the metrics server without touching the exposition
     metrics = Metrics()
     metrics.add_route("/debug/flight", fr.route())
@@ -736,7 +741,7 @@ def test_debug_flight_route_serves_live_ring():
             f"http://127.0.0.1:{port}/debug/flight"
         ) as resp:
             assert resp.status == 200
-            assert len(resp.read().splitlines()) == 2
+            assert len(resp.read().splitlines()) == 3
         with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics"
         ) as resp:
