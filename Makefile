@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention bench-capacity perf-gate lint clean
 
 all: proto native
 
@@ -143,6 +143,17 @@ bench-flight:
 bench-retention:
 	python bench.py --retention-only
 
+# the capacity-per-chip scenario alone: requests admitted per page
+# encoding (bf16 / int8 / fp8) from pools holding the SAME measured
+# HBM byte budget — pure admission accounting, the fp8/int8 ratio the
+# perf gate bands (lower fails: fp8's E8M0 scale bytes must keep
+# buying pages over int8's f32 scales) — plus the interleaved
+# fused-wave vs dense-wave run_waves replay (bitwise-asserted streams;
+# the wall ratio the gate bands, higher fails). Writes
+# artifacts/bench_capacity.json (schema v14 capacity block)
+bench-capacity:
+	python bench.py --capacity-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -169,6 +180,8 @@ perf-gate:
 		--baseline artifacts/bench_control.json --current artifacts/bench_control.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_retention.json --current artifacts/bench_retention.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_capacity.json --current artifacts/bench_capacity.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -72,7 +72,13 @@ Schema (``validate`` is the authoritative checker)::
                        "max_abs_skew_us": 0.0},  # v12: flight plane
       "retention": {"kept": 0.0, "evaluated": 0.0, "keep_rate": 0.0,
                     "overhead_ratio": 0.0,
-                    "incidents": 0.0}  # v13: tail-based retention
+                    "incidents": 0.0},  # v13: tail-based retention
+      "capacity": {"admitted_bf16": 0.0,
+                   "admitted_int8": 0.0,
+                   "admitted_fp8": 0.0,
+                   "capacity_admitted_ratio": 0.0,
+                   "fused_wave_ratio": 0.0,
+                   "budget_mib": 0.0}  # v14: capacity per chip
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -182,6 +188,17 @@ wall, both passes interleaved on the same host in the same session;
 the perf gate bands it, degradation = the ratio RISING — always-on
 retention must stay cheap enough to leave on), and the incidents the
 sentinel/burn triggers opened. v1-v12 artifacts remain valid.
+
+Schema v14 (the capacity-per-chip PR): the run's KV-capacity evidence
+rides along (:meth:`ArtifactRecorder.record_capacity`) — requests
+admitted before the allocator sheds on pools holding the SAME HBM byte
+budget under each page encoding (bf16 / int8 / fp8), the derived
+``capacity_admitted_ratio`` (fp8 admitted / int8 admitted; the perf
+gate bands it, degradation = the ratio FALLING — fp8's thinner scale
+side-channel must keep admitting more), and ``fused_wave_ratio``
+(fused-wave / dense-wave run_waves wall, both engines interleaved on
+the same host after a bitwise stream assert; banded like
+``fused_verify_ratio``). v1-v13 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -193,7 +210,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -328,6 +345,18 @@ EMPTY_RETENTION = {
     "incidents": 0.0,
 }
 
+#: v14: the capacity block's required shape (an empty block is valid —
+#: a run that never ran the capacity scenario still writes a v14
+#: artifact)
+EMPTY_CAPACITY = {
+    "admitted_bf16": 0.0,
+    "admitted_int8": 0.0,
+    "admitted_fp8": 0.0,
+    "capacity_admitted_ratio": 0.0,
+    "fused_wave_ratio": 0.0,
+    "budget_mib": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -414,6 +443,7 @@ class ArtifactRecorder:
         self.control: dict[str, Any] = copy.deepcopy(EMPTY_CONTROL)
         self.flight_plane: dict[str, float] = dict(EMPTY_FLIGHT_PLANE)
         self.retention: dict[str, float] = dict(EMPTY_RETENTION)
+        self.capacity: dict[str, float] = dict(EMPTY_CAPACITY)
 
     def section(
         self,
@@ -629,6 +659,19 @@ class ArtifactRecorder:
             key: float(summary[key]) for key in EMPTY_RETENTION
         }
 
+    def record_capacity(self, summary: dict[str, Any]) -> None:
+        """Adopt one capacity-per-chip summary (bench_capacity's
+        matched-HBM-budget admission counts plus the fused-wave wall
+        ratio) as the run's v14 ``capacity`` block. Last writer wins —
+        the block carries the HEADLINE fp8-vs-int8 admission comparison
+        on pools holding the same byte budget."""
+        for key in EMPTY_CAPACITY:
+            if key not in summary:
+                raise ValueError(f"capacity summary missing {key!r}")
+        self.capacity = {
+            key: float(summary[key]) for key in EMPTY_CAPACITY
+        }
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -679,6 +722,7 @@ class ArtifactRecorder:
             "control": copy.deepcopy(self.control),
             "flight_plane": dict(self.flight_plane),
             "retention": dict(self.retention),
+            "capacity": dict(self.capacity),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -809,6 +853,14 @@ def record_retention(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_retention(summary)
+
+
+def record_capacity(summary: dict) -> None:
+    """Adopt a capacity-per-chip summary into the active recorder's
+    v14 ``capacity`` block; no-op without one (same contract as
+    :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_capacity(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -1027,6 +1079,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"retention.{key} must be a number, "
                         f"got {retention.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 14:
+        # v14: capacity-per-chip evidence
+        capacity = obj.get("capacity")
+        if not isinstance(capacity, dict):
+            problems.append("capacity must be a dict (schema v14+)")
+        else:
+            for key in EMPTY_CAPACITY:
+                if not isinstance(capacity.get(key), (int, float)):
+                    problems.append(
+                        f"capacity.{key} must be a number, "
+                        f"got {capacity.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

@@ -151,6 +151,11 @@ class AutoscaleConfig:
     down_pressure: float = 0.25
     sustain_s: float = 10.0
     cooldown_s: float = 30.0
+    #: arm the daemon-owned periodic evaluator thread
+    #: (:class:`~beholder_tpu.control.evaluator.ScalingEvaluator`) at
+    #: this cadence; None (the default) keeps evaluation purely
+    #: boundary-driven (router ``run_pending`` / replay bursts)
+    evaluator_interval_s: float | None = None
 
     def __post_init__(self):
         if self.min_shards < 1:
@@ -174,6 +179,14 @@ class AutoscaleConfig:
             )
         if self.sustain_s < 0 or self.cooldown_s < 0:
             raise ValueError("sustain_s/cooldown_s must be >= 0")
+        if (
+            self.evaluator_interval_s is not None
+            and self.evaluator_interval_s <= 0
+        ):
+            raise ValueError(
+                f"evaluator_interval_s must be > 0, "
+                f"got {self.evaluator_interval_s}"
+            )
 
 
 @dataclass
@@ -229,7 +242,8 @@ def control_from_config(config) -> ControlConfig | None:
     ``spec.{enabled, burn_threshold, shed_to}``;
     ``routing.{enabled, tail_threshold, deadline_slack_s}``;
     ``autoscale.{enabled, min_shards, max_shards, up_burn,
-    up_pressure, down_burn, down_pressure, sustain_s, cooldown_s}``."""
+    up_pressure, down_burn, down_pressure, sustain_s, cooldown_s,
+    evaluator_interval_s}``."""
     node = config.get("instance.control")
     if node is None or not node.get("enabled"):
         return None
@@ -269,6 +283,11 @@ def control_from_config(config) -> ControlConfig | None:
             ),
             sustain_s=float(node.get("autoscale.sustain_s", 10.0)),
             cooldown_s=float(node.get("autoscale.cooldown_s", 30.0)),
+            evaluator_interval_s=(
+                float(node.get("autoscale.evaluator_interval_s"))
+                if node.get("autoscale.evaluator_interval_s") is not None
+                else None
+            ),
         )
     default_quota = node.get("default_quota")
     return ControlConfig(
@@ -295,6 +314,10 @@ def __getattr__(name: str):
         from .policy import ControlPlane
 
         return ControlPlane
+    if name == "ScalingEvaluator":
+        from .evaluator import ScalingEvaluator
+
+        return ScalingEvaluator
     if name in ("Scenario", "replay", "SCENARIOS"):
         from . import replay
 

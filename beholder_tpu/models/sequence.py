@@ -57,11 +57,13 @@ def _pool_write_column(pool, info: PagedInfo, col: jax.Array):
     """Scatter each slot's new (Hkv, Dh) kv column into its write page
     at its write offset — (tokens-on-lanes pool layout, so the column
     lands on one lane). Out-of-bounds page ids (inactive slots) drop.
-    Int8 pools quantize the column per (head, token) on the way in."""
+    Quantized pools (int8 or fp8) quantize the column per (head, token)
+    on the way in, through the pool's own scheme."""
     if isinstance(pool, QuantizedPool):
-        from beholder_tpu.ops.quant import quantize_symmetric
+        from beholder_tpu.ops.quant import pool_quantize
 
-        q, scale = quantize_symmetric(col, axis=-1)  # scale (S, Hkv)
+        # scale (S, Hkv)
+        q, scale = pool_quantize(col, axis=-1, values_dtype=pool.values.dtype)
         return QuantizedPool(
             pool.values.at[info.write_pages, :, :, info.write_offsets].set(
                 q, mode="drop"

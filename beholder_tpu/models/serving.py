@@ -30,6 +30,17 @@ a CHANGING population of requests the way modern LLM servers do
   measured per round in BENCH_NOTES.md (~1.2x at the headline shape,
   ~0.8x at long context where the kernel is issue-bound, not
   bandwidth-bound) — int8's contract here is capacity, not speed.
+- **fp8 KV cache** (``cache_dtype="fp8"``): pages are ``float8_e4m3fn``
+  values + uint8 E8M0 per-(token, head) scales (``2**(e - 127)``, the
+  MX block-format scale encoding — see :mod:`beholder_tpu.ops.quant`).
+  Values stay 8-bit; the capacity win over int8 is the SCALE
+  side-channel (4 bytes -> 1 byte per (head, token) block): page bytes
+  go from ``Hkv*page*(Dh + 4)`` to ``Hkv*page*(Dh + 1)``, so the same
+  HBM budget holds more pages — large at telemetry head dims (~15% more
+  at Dh=16), modest at LLM dims (~2% at Dh=128); the honest numbers are
+  pinned per round in BENCH_NOTES.md. Same values+scales container as
+  int8, so export/import, drain migration, and prefix pins move fp8
+  pages byte-identically with ZERO new structural code paths.
 - **Prefix sharing** (:func:`paged_fork` / :meth:`ContinuousBatcher.
   run_what_if`): one sequence forked into k branches shares its FULL
   prefix pages by refcount (``page_ref``) — a slot only writes at its
@@ -72,7 +83,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
-from beholder_tpu.ops.paged_attention import PagedInfo, QuantizedPool
+from beholder_tpu.ops.paged_attention import (
+    PagedInfo,
+    QuantizedPool,
+    pool_dtype_family,
+)
 from beholder_tpu.tracing import current_trace_id, from_traceparent
 
 from .sequence import TelemetrySequenceModel
@@ -120,11 +135,29 @@ def init_paged(
     dh = model.dim // model.heads
     hkv = model.kv_heads or model.heads
     shape = (num_pages, hkv, dh, page_size)
+    if cache_dtype in ("bf16", "bfloat16"):
+        cache_dtype = jnp.bfloat16  # config-file spelling
     if cache_dtype in (jnp.int8, "int8"):
         def pool():
             return QuantizedPool(
                 jnp.zeros(shape, jnp.int8),
                 jnp.ones((num_pages, hkv, page_size), jnp.float32),
+            )
+    elif cache_dtype in (jnp.float8_e4m3fn, "fp8"):
+        # fp8 shared-exponent pages: float8_e4m3fn values + uint8 E8M0
+        # per-(head, token) scales (127 = biased exponent of 2**0, the
+        # identity scale — the fp8 twin of int8's f32 ones). Same
+        # values+scales container as int8, so every structural pool op
+        # (export/import, migration, forks, prefix pins) is already
+        # generic over it.
+        from beholder_tpu.ops.quant import E8M0_BIAS
+
+        def pool():
+            return QuantizedPool(
+                jnp.zeros(shape, jnp.float8_e4m3fn),
+                jnp.full(
+                    (num_pages, hkv, page_size), E8M0_BIAS, jnp.uint8
+                ),
             )
     else:
         def pool():
@@ -216,7 +249,12 @@ def slot_cache(state: PagedKVState, slot: int, layer: int):
 
     def dense(pool):
         if isinstance(pool, QuantizedPool):
-            vals = pool.values.astype(jnp.float32) * pool.scales[:, :, None, :]
+            from beholder_tpu.ops.quant import pool_scales_f32
+
+            vals = (
+                pool.values.astype(jnp.float32)
+                * pool_scales_f32(pool.scales)[:, :, None, :]
+            )
         else:
             vals = pool.astype(jnp.float32)
         g = vals[state.page_table[slot]]          # (P, Hkv, Dh, page)
@@ -271,20 +309,22 @@ def paged_decode_tick(
     return preds[:, 0], state
 
 
-def _quantize_tokens(x: jax.Array):
-    """(..., Dh, T) -> int8 values + (..., T) per-(head, token) scales —
-    the shared symmetric scheme (one definition; the decode tick's
+def _quantize_tokens(x: jax.Array, values_dtype):
+    """(..., Dh, T) -> 8-bit values + (..., T) per-(head, token) scales
+    via the pool's scheme (int8 symmetric or fp8/E8M0 — ONE dispatch in
+    :func:`beholder_tpu.ops.quant.pool_quantize`; the decode tick's
     column writes must match the admit path's chunk writes exactly)."""
-    from beholder_tpu.ops.quant import quantize_symmetric
+    from beholder_tpu.ops.quant import pool_quantize
 
-    return quantize_symmetric(x, axis=-2)
+    return pool_quantize(x, axis=-2, values_dtype=values_dtype)
 
 
 def _write_chunks(pool, drop_pages, chunks):
     """Scatter (n, Hkv, Dh, page) chunks into pool rows ``drop_pages``
-    (OOB entries dropped), quantizing per token when the pool is int8."""
+    (OOB entries dropped), quantizing per token when the pool is
+    quantized (int8 or fp8)."""
     if isinstance(pool, QuantizedPool):
-        q, scale = _quantize_tokens(chunks)
+        q, scale = _quantize_tokens(chunks, pool.values.dtype)
         return QuantizedPool(
             pool.values.at[drop_pages].set(q, mode="drop"),
             pool.scales.at[drop_pages].set(scale, mode="drop"),
@@ -322,12 +362,28 @@ def paged_admit_batch(
     slot_ids: jax.Array,
     feats_padded: jax.Array,
     prefix_lens: jax.Array,
+    fused: bool = False,
 ):
     """Admit a WAVE of requests in one prefill: ``feats_padded`` is
     (n, T_max, F) (page-multiple T_max), ``slot_ids``/``prefix_lens``
     are (n,). A request with ``prefix_lens[i] == 0`` is skipped (slot id
     should then be out of range so its table write drops). Returns
-    ((n,) last predictions, state)."""
+    ((n,) last predictions, state).
+
+    The default (``fused=False``, the reference oracle) runs the plain
+    dense prefill (``return_kv=True``): each layer materializes a
+    (n, Hkv, T_max, Dh) context buffer for the wave. With ``fused=True``
+    (the fused-wave lane — ``instance.serving.fused_wave``) the SAME
+    forward instead routes through the fused chunk kernel
+    (:func:`~beholder_tpu.ops.paged_attention.paged_chunk_attention`)
+    with an EMPTY paged context (lens 0): wave membership is just the
+    chunk slot set, attention is causal within each chunk exactly like
+    the dense program, and no dense per-wave context transient ever
+    lands — the no-transient contract the spec-verify and prefix-suffix
+    paths already have, extended to fixed-horizon fleets. Both branches
+    return the chunk's own kv columns, so the page scatter below is
+    shared; the lane is bitwise-pinned against the dense wave program
+    (tests/test_serving.py)."""
     num_pages, page = _pool_geometry(state)
     slots, max_pages = state.page_table.shape
     n, t_max, _ = feats_padded.shape
@@ -335,7 +391,24 @@ def paged_admit_batch(
         raise ValueError(f"padded prefix {t_max} not a page multiple ({page})")
     p_max = t_max // page
 
-    preds, kvs = model.apply(params, feats_padded, return_kv=True)
+    if fused:
+        # empty context: one (ignored) page per row, all lens 0; ctx
+        # width t_max — the dense branch's buffer width, so the math
+        # (and its accumulation order) is the dense program's, column
+        # for column
+        from beholder_tpu.ops.paged_attention import ChunkPagedInfo
+
+        info = ChunkPagedInfo(
+            jnp.zeros((n, 1), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            t_max,
+        )
+        preds, kvs = model.apply(
+            params, feats_padded,
+            cache=(state.k_pools, state.v_pools, info),
+        )
+    else:
+        preds, kvs = model.apply(params, feats_padded, return_kv=True)
     last_pred = preds[
         jnp.arange(n), jnp.clip(prefix_lens - 1, 0, t_max - 1)
     ]
@@ -461,9 +534,11 @@ def paged_admit_with_prefix(
         def dense_context(pool):
             """(1, Hkv, t_hit, Dh) context from the cached pages (bf16)."""
             if isinstance(pool, QuantizedPool):
+                from beholder_tpu.ops.quant import pool_scales_f32
+
                 vals = (
                     pool.values.astype(jnp.float32)
-                    * pool.scales[:, :, None, :]
+                    * pool_scales_f32(pool.scales)[:, :, None, :]
                 ).astype(jnp.bfloat16)
             else:
                 vals = pool.astype(jnp.bfloat16)
@@ -896,6 +971,7 @@ def serve_wave(
     last_statuses: jax.Array,
     n_ticks: int,
     horizons: tuple[int, ...] | None = None,
+    fused: bool = False,
 ):
     """One whole serving wave as ONE compiled program: admit ``n``
     requests into slots ``0..n-1`` (batched prefill), roll every slot
@@ -903,14 +979,17 @@ def serve_wave(
     wave's pages — a single dispatch with zero host round-trips (each
     device->host read costs ~65 ms on a tunneled accelerator; see the
     module docstring). ``feats_padded`` is (n, T_max, F),
-    ``prefix_lens``/``last_statuses`` are (n,). Returns
-    ((n, n_ticks + 1) forecast deltas, state) — or, with a static
-    ``horizons`` tuple, a tuple of per-request ``(horizons[i],)``
-    forecast arrays trimmed in-program."""
+    ``prefix_lens``/``last_statuses`` are (n,). ``fused=True`` routes
+    the wave prefill through the fused chunk kernel instead of the
+    dense per-wave context (see :func:`paged_admit_batch` — bitwise
+    the same program). Returns ((n, n_ticks + 1) forecast deltas,
+    state) — or, with a static ``horizons`` tuple, a tuple of
+    per-request ``(horizons[i],)`` forecast arrays trimmed
+    in-program."""
     n = feats_padded.shape[0]
     preds, state = paged_admit_batch(
         model, params, state, jnp.arange(n, dtype=jnp.int32),
-        feats_padded, prefix_lens,
+        feats_padded, prefix_lens, fused=fused,
     )
     deltas, state = _roll_and_release(
         model, params, state, preds, last_statuses, n, n_ticks
@@ -1495,6 +1574,7 @@ class ContinuousBatcher:
         spec=None,
         flight_recorder=None,
         fused_verify: bool = False,
+        fused_wave: bool = False,
         autotune_table: str | None = None,
     ):
         self.model = model
@@ -1564,6 +1644,12 @@ class ContinuousBatcher:
         #: step engine timeline. None (the default) records nothing and
         #: leaves every path byte-identical.
         self.flight_recorder = flight_recorder
+        if flight_recorder is not None:
+            # arm the autotuner's malformed-table reporting (process-
+            # global like the table itself; see autotune.set_recorder)
+            from beholder_tpu.ops import autotune as _autotune
+
+            _autotune.set_recorder(flight_recorder)
         #: fused paged verify/prefix attention
         #: (``instance.serving.fused_verify``): spec verify rounds and
         #: prefix-hit admissions attend the paged pools IN PLACE
@@ -1579,6 +1665,16 @@ class ContinuousBatcher:
         #: requests fit a pool). Off (False, the default) every path
         #: is byte-identical to the dense-gather batcher.
         self.fused_verify = bool(fused_verify)
+        #: fused wave prefill (``instance.serving.fused_wave``):
+        #: :meth:`run_waves` admits each wave through the fused chunk
+        #: kernel with an empty paged context instead of the dense
+        #: per-wave (n, Hkv, T_max, Dh) context buffers — wave
+        #: membership IS the chunk slot set (see
+        #: :func:`paged_admit_batch`). Bitwise-identical deltas either
+        #: way (pinned by tests/test_serving.py); the knob joins the
+        #: serve-program jit key. Off (False, the default) the wave
+        #: path is byte-identical to before the lane existed.
+        self.fused_wave = bool(fused_wave)
         if autotune_table is not None:
             # point the kernel's block-size table at the configured
             # location (``instance.serving.autotune.table``) before the
@@ -1932,6 +2028,18 @@ class ContinuousBatcher:
         if self.flight_recorder is None:
             return {}
         return self.flight_recorder.kernel_tags(family, flops)
+
+    @property
+    def pool_family(self) -> str:
+        """The KV pool's dtype family (``"bf16"``/``"int8"``/``"fp8"``)
+        — the same label the autotune table keys by, used to qualify
+        the fused verify round's roofline family so each encoding's
+        achieved ceiling fraction gates as its own series."""
+        pool = self.state.k_pools[0]
+        quantized = isinstance(pool, QuantizedPool)
+        return pool_dtype_family(
+            pool.values if quantized else pool, quantized=quantized
+        )
 
     def _flops_per_token(self, ctx: float) -> float:
         from beholder_tpu.obs.roofline import model_flops_per_token
@@ -2475,10 +2583,13 @@ class ContinuousBatcher:
     def _serve_fn(
         self, n: int, n_ticks: int, horizons: tuple[int, ...] | None = None
     ):
+        # the fused_wave knob joins the static key: flipping it mid-
+        # process recompiles rather than serving a stale program
         return self._cached_jit(
-            (n, n_ticks, horizons),
+            (n, n_ticks, horizons, self.fused_wave),
             lambda: lambda p, s, f, ln, st: serve_wave(
-                self.model, p, s, f, ln, st, n_ticks, horizons=horizons
+                self.model, p, s, f, ln, st, n_ticks, horizons=horizons,
+                fused=self.fused_wave,
             ),
         )
 

@@ -45,16 +45,18 @@ from typing import Any
 #: the dispatch-phase -> kernel-family DOCUMENTATION map (the serving
 #: layer passes the family string to kernel_tags() at the call site;
 #: nothing looks families up here). ``verify`` rounds carry family
-#: "verify" on the dense-gather path and "paged_chunk" with the fused
-#: kernel armed (ContinuousBatcher(fused_verify=True) —
-#: ops.paged_attention.paged_chunk_attention), so the perf gate's
-#: per-family ``kernel_ceiling_frac`` check sees the fused kernel's
-#: achieved ceiling fraction as its own series.
+#: "verify" on the dense-gather path and "paged_chunk:<family>" with
+#: the fused kernel armed (ContinuousBatcher(fused_verify=True) —
+#: ops.paged_attention.paged_chunk_attention), where ``<family>`` is
+#: the pool's dtype family (``bf16``/``int8``/``fp8``, the same labels
+#: the autotune table keys by) — so the perf gate's per-family
+#: ``kernel_ceiling_frac`` check sees EACH page encoding's achieved
+#: ceiling fraction as its own series.
 PHASE_FAMILIES = {
     "admit": "flash",    # prefill: dense/flash-path forwards
     "wave": "paged",     # fused admit+scan: decode-dominated
     "tick": "paged",     # paged decode ticks
-    "verify": "verify",  # spec chunked verify ("paged_chunk" fused)
+    "verify": "verify",  # spec verify ("paged_chunk:<dtype>" fused)
 }
 
 

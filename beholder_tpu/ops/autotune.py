@@ -29,14 +29,31 @@ therefore bitwise-identical to the default-config kernel — the
 autotuner moves wall time only (pinned by
 ``tests/test_paged_chunk_kernel.py``).
 
-Table schema (``validate_table`` is the checker)::
+Table schema v2 (``validate_table`` is the checker) groups entries per
+DTYPE FAMILY — the pool encoding (bf16 / int8 / fp8) changes the
+kernel's DMA bytes and dequant arithmetic, so each family earns its own
+measured winners and its own ``kernel_ceiling_frac:paged_chunk:<f>``
+band in the perf gate::
 
-    {"schema": "beholder-autotune-table", "schema_version": 1,
-     "entries": {"<shape_key>": {"config": {"slots_per_block": 4,
-                                            "pages_per_block": 2},
-                                 "per_call_s": 1.2e-4,
-                                 "candidates": {"<cfg>": s, ...},
-                                 "measured_unix_s": ...}}}
+    {"schema": "beholder-autotune-table", "schema_version": 2,
+     "families": {"bf16": {"<base_key>": {
+                      "config": {"slots_per_block": 4,
+                                 "pages_per_block": 2},
+                      "per_call_s": 1.2e-4,
+                      "candidates": {"<cfg>": s, ...},
+                      "measured_unix_s": ...}},
+                  "int8": {...}, "fp8": {...}}}
+
+``<base_key>`` is :func:`shape_key` minus its trailing ``/<dtype>``
+segment; runtime lookups still use the FULL key (the in-memory view is
+flat — ``base_key/family``), so kernel builds are untouched by the
+restructure. v1 tables (flat ``entries``) still load: the fallback
+direction must stay "old table reads fine", never "old table crashes
+the build". A malformed table no longer falls back in silence — the
+first bad read logs one warning (and emits an ``autotune.table_bad``
+recorder instant when a flight recorder is armed via
+:func:`set_recorder`), so a corrupt committed table cannot quietly
+serve :data:`DEFAULTS` forever.
 """
 
 from __future__ import annotations
@@ -47,7 +64,11 @@ import threading
 from typing import Any, Callable
 
 SCHEMA = "beholder-autotune-table"
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+#: the dtype families a pool can resolve to (see
+#: :func:`beholder_tpu.ops.paged_attention.pool_dtype_family`)
+FAMILIES = ("bf16", "int8", "fp8")
 
 #: the cold-miss fallback: safe everywhere (divisor-clamped at build),
 #: measured-reasonable on the CPU interpreter and small TPU shapes
@@ -68,6 +89,18 @@ DEFAULT_TABLE_PATH = os.path.join(
 _lock = threading.Lock()
 _table: dict[str, Any] | None = None
 _table_path: str | None = None
+_recorder: Any = None
+_warned_paths: set[str] = set()
+
+
+def set_recorder(recorder: Any) -> None:
+    """Arm (or with ``None`` disarm) the flight recorder malformed-table
+    reads report to. Process-global like :func:`configure` — the table
+    is a property of the host, and the read that discovers corruption
+    happens once per process, not once per batcher."""
+    global _recorder
+    with _lock:
+        _recorder = recorder
 
 
 def shape_key(
@@ -125,53 +158,143 @@ def load_table(path: str | None = None) -> dict[str, Any]:
 def _read_entries(path: str) -> dict[str, Any]:
     try:
         with open(path) as f:
-            obj = json.load(f)
+            raw = f.read()
+    except OSError:
+        return {}  # genuinely absent — the expected cold start
+    try:
+        obj = json.loads(raw)
         validate_table(obj)
-        return dict(obj["entries"])
-    except (OSError, ValueError, KeyError, TypeError):
+        return flat_entries(obj)
+    except (ValueError, KeyError, TypeError) as err:
+        # json.JSONDecodeError is a ValueError: unparseable counts as
+        # malformed (loud), not absent (silent)
+        _warn_malformed(path, err)
         return {}
+
+
+def _warn_malformed(path: str, err: Exception) -> None:
+    """One warning per path per process (the read retries on every
+    ``configure``, and a corrupt file would otherwise spam), plus an
+    ``autotune.table_bad`` instant when a recorder is armed — the
+    satellite contract: a malformed COMMITTED table must be loud, not
+    a silent permanent fallback to :data:`DEFAULTS`."""
+    if path in _warned_paths:
+        return
+    _warned_paths.add(path)
+    from beholder_tpu.log import get_logger
+
+    get_logger("ops.autotune").warning(
+        "autotune table %s is malformed (%s); serving DEFAULTS for "
+        "every shape until it is regenerated",
+        path,
+        err,
+    )
+    if _recorder is not None:
+        try:
+            _recorder.instant(
+                "autotune.table_bad", path=path, error=str(err)
+            )
+        except Exception:
+            pass  # observability must never take the build down
+
+
+def flat_entries(obj: dict[str, Any]) -> dict[str, Any]:
+    """A validated table object's entries as the FLAT runtime view
+    (``base_key/family`` -> entry): v2 families are joined back onto
+    their base keys; v1 flat entries pass through."""
+    if "families" in obj:
+        return {
+            f"{base}/{family}": entry
+            for family, rows in obj["families"].items()
+            for base, entry in rows.items()
+        }
+    return dict(obj["entries"])
+
+
+def _validate_entry(key: str, entry: Any) -> None:
+    if not isinstance(entry, dict) or not isinstance(
+        entry.get("config"), dict
+    ):
+        raise ValueError(f"entry {key!r} must carry a config dict")
+    for knob, value in entry["config"].items():
+        if not isinstance(value, int) or value < 1:
+            raise ValueError(
+                f"entry {key!r} config {knob}={value!r} must be a "
+                "positive int"
+            )
+    if not isinstance(entry.get("per_call_s"), (int, float)):
+        raise ValueError(f"entry {key!r} needs a numeric per_call_s")
 
 
 def validate_table(obj: Any) -> None:
     """Raise ``ValueError`` unless ``obj`` is a well-formed table —
-    the CI artifact gate's check on the committed file."""
+    the CI artifact gate's check on the committed file. Accepts both
+    layouts: v2 (``families`` -> family -> base-key entries) and the
+    legacy v1 flat ``entries`` dict."""
     if not isinstance(obj, dict):
         raise ValueError("autotune table must be a dict")
     if obj.get("schema") != SCHEMA:
         raise ValueError(f"schema must be {SCHEMA!r}, got {obj.get('schema')!r}")
     if not isinstance(obj.get("schema_version"), int):
         raise ValueError("schema_version must be an int")
+    if "families" in obj:
+        families = obj["families"]
+        if not isinstance(families, dict):
+            raise ValueError("families must be a dict")
+        for family, rows in families.items():
+            if family not in FAMILIES:
+                raise ValueError(
+                    f"unknown dtype family {family!r} (want one of "
+                    f"{FAMILIES})"
+                )
+            if not isinstance(rows, dict):
+                raise ValueError(f"family {family!r} must map to a dict")
+            for base, entry in rows.items():
+                _validate_entry(f"{base}/{family}", entry)
+        return
     entries = obj.get("entries")
     if not isinstance(entries, dict):
         raise ValueError("entries must be a dict")
     for key, entry in entries.items():
-        if not isinstance(entry, dict) or not isinstance(
-            entry.get("config"), dict
-        ):
-            raise ValueError(f"entry {key!r} must carry a config dict")
-        for knob, value in entry["config"].items():
-            if not isinstance(value, int) or value < 1:
-                raise ValueError(
-                    f"entry {key!r} config {knob}={value!r} must be a "
-                    "positive int"
-                )
-        if not isinstance(entry.get("per_call_s"), (int, float)):
-            raise ValueError(f"entry {key!r} needs a numeric per_call_s")
+        _validate_entry(key, entry)
+
+
+#: legacy v1 dtype spellings -> v2 family names (a v1 table loaded and
+#: re-saved migrates its keys instead of crashing the save)
+_FAMILY_ALIASES = {"bfloat16": "bf16"}
+
+
+def _split_family(key: str) -> tuple[str, str]:
+    """``base/family`` from a full shape key (the dtype family is the
+    last ``/``-segment by :func:`shape_key`'s construction); legacy v1
+    dtype spellings migrate to their family name."""
+    base, _, family = key.rpartition("/")
+    family = _FAMILY_ALIASES.get(family, family)
+    if not base or family not in FAMILIES:
+        raise ValueError(
+            f"key {key!r} does not end in a dtype family {FAMILIES}"
+        )
+    return base, family
 
 
 def save_table(
     entries: dict[str, Any], path: str | None = None
 ) -> str:
-    """Persist ``entries`` (and, when writing the ACTIVE table, refresh
-    the cache so builds in this process see the new winners
-    immediately — a side copy saved to an explicit other path must not
+    """Persist ``entries`` — the FLAT runtime view, regrouped into the
+    v2 per-family layout on disk — and, when writing the ACTIVE table,
+    refresh the cache so builds in this process see the new winners
+    immediately (a side copy saved to an explicit other path must not
     hijack what :func:`resolve_config` resolves). Returns the path."""
     global _table
     path = path or table_path()
+    families: dict[str, dict[str, Any]] = {}
+    for key, entry in entries.items():
+        base, family = _split_family(key)
+        families.setdefault(family, {})[base] = entry
     obj = {
         "schema": SCHEMA,
         "schema_version": SCHEMA_VERSION,
-        "entries": entries,
+        "families": families,
     }
     validate_table(obj)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -193,7 +316,18 @@ def resolve_config(
     jit cache keys on the normalized config tuple)."""
     if explicit is not None:
         return {**DEFAULTS, **explicit}
-    entry = load_table().get(key)
+    table = load_table()
+    entry = table.get(key)
+    if entry is None:
+        # legacy dtype spellings resolve to their canonical family
+        # (".../bfloat16" finds the migrated ".../bf16" entry); keys
+        # outside any family are plain misses, not errors
+        try:
+            base, family = _split_family(key)
+        except ValueError:
+            pass
+        else:
+            entry = table.get(f"{base}/{family}")
     if entry is not None and isinstance(entry.get("config"), dict):
         return {**DEFAULTS, **entry["config"]}
     return dict(DEFAULTS)

@@ -37,11 +37,17 @@ Kernel design:
 - Grouped-query attention is native: q carries H = G * Hkv heads, the
   pools carry Hkv; q head h reads pool head h // G (a static slice — the
   group loop is unrolled).
-- Int8 pools (``k_scale``/``v_scale`` given): pages are stored int8 with
-  per-(token, head) float32 scales and dequantized IN the kernel right
-  after the DMA — int8 is the HBM-resident representation, so the
-  cache's HBM FOOTPRINT halves vs bf16 (the capacity lever; composes
-  with GQA). The throughput effect is shape-dependent and measured, not
+- Quantized pools (``k_scale``/``v_scale`` given): pages are stored
+  8-bit — int8 values with per-(token, head) float32 scales, or
+  ``float8_e4m3fn`` values with uint8 E8M0 shared-exponent scales
+  (``scale = 2**(e - 127)``; see :mod:`beholder_tpu.ops.quant`) — and
+  dequantized IN the kernel right after the DMA: 8-bit stays the
+  HBM-resident representation, so the cache's HBM FOOTPRINT halves vs
+  bf16 (the capacity lever; composes with GQA), and fp8's 1-byte
+  scales shave the scale side-channel on top (4 bytes → 1 per
+  (head, token) block). E8M0 dequant is a pure f32 exponent shift —
+  exact — so the bitwise kernel-vs-oracle contract needs no new
+  tolerance argument for fp8. The throughput effect is shape-dependent and measured, not
   assumed: at the headline serving shape int8 decode runs ~1.2x bf16
   (BENCH r05 ``serving.int8_value``), but at long context the kernel is
   DMA-issue/VPU-bound, not bandwidth-bound, and the in-kernel dequant
@@ -77,18 +83,45 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from beholder_tpu.ops.quant import pool_scales_f32
+
 _NEG_INF = -1e30
 
 
 class QuantizedPool(NamedTuple):
-    """Int8 KV page pool: ``values`` (N, Hkv, Dh, page) int8 plus
-    per-(head, token) symmetric ``scales`` (N, Hkv, page) f32 —
-    ``k ≈ values * scales`` with tokens on lanes. The decode kernel
-    dequantizes right after each page DMA, so int8 is the HBM-resident
-    representation (half the cache bytes AND half the page traffic)."""
+    """Quantized KV page pool: ``values`` (N, Hkv, Dh, page) plus
+    per-(head, token) ``scales`` (N, Hkv, page) with tokens on lanes —
+    ``k ≈ dequant(values) * pool_scales_f32(scales)``. Two encodings
+    share this ONE container (so export/import, drain migration, forks
+    and the prefix cache move either byte-identically with zero new
+    code paths):
+
+    - **int8**: int8 values, f32 symmetric scales (PR 4/10);
+    - **fp8**: ``float8_e4m3fn`` values, uint8 E8M0 shared-exponent
+      scales (``cache_dtype="fp8"`` — the scale side-channel drops
+      4 bytes → 1 per block; see :mod:`beholder_tpu.ops.quant`).
+
+    The kernels dequantize right after each page DMA, so the 8-bit
+    form is the HBM-resident representation (half the cache bytes AND
+    half the page traffic vs bf16)."""
 
     values: jax.Array
     scales: jax.Array
+
+
+def pool_dtype_family(pool_values: jax.Array, *, quantized: bool) -> str:
+    """The autotune-table dtype family of a pool: ``"bf16"``,
+    ``"int8"``, or ``"fp8"`` (anything else keys by its dtype name —
+    exact keys, never bucketing)."""
+    if quantized:
+        return (
+            "fp8" if pool_values.dtype == jnp.float8_e4m3fn else "int8"
+        )
+    return (
+        "bf16"
+        if pool_values.dtype == jnp.bfloat16
+        else str(pool_values.dtype)
+    )
 
 
 class PagedInfo(NamedTuple):
@@ -230,17 +263,19 @@ def _paged_kernel(
             rows = slice(s * h, (s + 1) * h)
             m = m_ref[rows, :1]  # (H, 1); lanes hold copies
             if quant:  # dequant right after the DMA: per-(head, token)
-                # scales broadcast over Dh. Dequantized pages are cast
-                # to bf16 so BOTH dots run at bf16 MXU rate (an f32 dot
-                # costs ~4 MXU passes). bf16 rounding is noise next to
-                # the int8 quantization error already present.
+                # scales broadcast over Dh (f32 for int8 pools; uint8
+                # E8M0 exponents for fp8 pools — pool_scales_f32 is the
+                # shared decoder). Dequantized pages are cast to bf16 so
+                # BOTH dots run at bf16 MXU rate (an f32 dot costs ~4
+                # MXU passes). bf16 rounding is noise next to the 8-bit
+                # quantization error already present.
                 kpage = (
                     kbuf[buf, s].astype(jnp.float32)
-                    * ksbuf[buf, s][:, None, :]
+                    * pool_scales_f32(ksbuf[buf, s])[:, None, :]
                 ).astype(jnp.bfloat16)
                 vpage = (
                     vbuf[buf, s].astype(jnp.float32)
-                    * vsbuf[buf, s][:, None, :]
+                    * pool_scales_f32(vsbuf[buf, s])[:, None, :]
                 ).astype(jnp.bfloat16)
             else:
                 # cache dtype (bf16) on the MXU with f32 accumulation,
@@ -336,8 +371,11 @@ def _paged_call(
     scratch = [
         pltpu.VMEM((2, slots, hkv, dh, page), k_pool.dtype),  # kbuf
         pltpu.VMEM((2, slots, hkv, dh, page), v_pool.dtype),  # vbuf
-        pltpu.VMEM((2, slots, hkv, page), jnp.float32) if quant else None,
-        pltpu.VMEM((2, slots, hkv, page), jnp.float32) if quant else None,
+        # scale staging buffers match the pool's scale dtype (f32 for
+        # int8 pools, uint8 E8M0 for fp8 pools) — the DMA moves raw
+        # scale bytes; decoding happens at the dequant site
+        pltpu.VMEM((2, slots, hkv, page), k_scale.dtype) if quant else None,
+        pltpu.VMEM((2, slots, hkv, page), v_scale.dtype) if quant else None,
         pltpu.VMEM((slots * h, 128), jnp.float32),  # m (lane-broadcast)
         pltpu.VMEM((slots * h, 128), jnp.float32),  # l
         pltpu.VMEM((slots * h, dh), jnp.float32),   # acc
@@ -391,8 +429,9 @@ def paged_decode_attention(
     - ``q``: (S, H, Dh) — slot ``s``'s query for position ``lens[s]``
       (whose kv column must already be scattered into the pool).
     - ``k_pool``/``v_pool``: (N, Hkv, Dh, page) page pools — tokens on
-      the minor (lane) dim, see module docstring (bf16, or int8 with
-      ``k_scale``/``v_scale`` (N, Hkv, page) f32 per-token scales). On
+      the minor (lane) dim, see module docstring (bf16; int8 with
+      ``k_scale``/``v_scale`` (N, Hkv, page) f32 per-token scales; or
+      fp8 with uint8 E8M0 scales of the same shape). On
       real TPUs ``page`` must be a multiple of 128 (lane alignment for
       the in-place page DMAs).
     - ``page_table``: (S, P); entry ``(s, i)`` is the pool page holding
@@ -650,16 +689,19 @@ def _chunk_kernel(
                             sems.at[3, buf, s, j],
                         ).wait()
                         # dequant right after the DMA: per-(head, token)
-                        # scales broadcast over Dh, rounded to bf16 —
-                        # the EXACT arithmetic of the dense oracle's
-                        # _gather_dense, so int8 fused == int8 dense
+                        # scales broadcast over Dh (decoded through
+                        # pool_scales_f32 — f32 pass-through for int8,
+                        # exact E8M0 exponent shift for fp8), rounded
+                        # to bf16 — the EXACT arithmetic of the dense
+                        # oracle's _gather_dense, so quantized fused ==
+                        # quantized dense for both families
                         kctx[s, :, :, dst] = (
                             kstage[buf, s, j].astype(jnp.float32)
-                            * ksstage[buf, s, j][:, None, :]
+                            * pool_scales_f32(ksstage[buf, s, j])[:, None, :]
                         ).astype(jnp.bfloat16)
                         vctx[s, :, :, dst] = (
                             vstage[buf, s, j].astype(jnp.float32)
-                            * vsstage[buf, s, j][:, None, :]
+                            * pool_scales_f32(vsstage[buf, s, j])[:, None, :]
                         ).astype(jnp.bfloat16)
                     else:
                         pltpu.make_async_copy(
@@ -691,7 +733,9 @@ def _chunk_kernel(
             if quant:
                 g = (
                     g.astype(jnp.float32)
-                    * scale_ref[...][block_tab][:, :, :, None, :]
+                    * pool_scales_f32(
+                        scale_ref[...][block_tab]
+                    )[:, :, :, None, :]
                 ).astype(jnp.bfloat16)
             g = g.transpose(0, 2, 3, 1, 4).reshape(
                 sb, hkv, dh, live_pages * page
@@ -802,11 +846,12 @@ def _chunk_reference(
         if quant:
             # dequant AFTER the gather: only gathered pages pay the
             # bf16 inflation (the dense oracle inflates the WHOLE pool
-            # first); per-element arithmetic is identical, so values
-            # still match the oracle bitwise
+            # first); per-element arithmetic is identical (the shared
+            # pool_scales_f32 decoder handles both f32 and E8M0
+            # scales), so values still match the oracle bitwise
             g = (
                 g.astype(jnp.float32)
-                * scales[block_tab][:, :, :, None, :]
+                * pool_scales_f32(scales[block_tab])[:, :, :, None, :]
             ).astype(jnp.bfloat16)
         else:
             g = g.astype(jnp.bfloat16)
@@ -864,10 +909,16 @@ def _chunk_call(
     scratch = [
         pltpu.VMEM((sb, hkv, dh, ctx_len), jnp.bfloat16),  # kctx
         pltpu.VMEM((sb, hkv, dh, ctx_len), jnp.bfloat16),  # vctx
-        pltpu.VMEM((2, sb, pb, hkv, dh, page), jnp.int8) if staged else None,
-        pltpu.VMEM((2, sb, pb, hkv, dh, page), jnp.int8) if staged else None,
-        pltpu.VMEM((2, sb, pb, hkv, page), jnp.float32) if staged else None,
-        pltpu.VMEM((2, sb, pb, hkv, page), jnp.float32) if staged else None,
+        # staging buffers carry the pool's raw value/scale dtypes
+        # (int8 + f32, or fp8 + uint8 E8M0); dequant decodes post-wait
+        pltpu.VMEM((2, sb, pb, hkv, dh, page), k_pool.dtype)
+        if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, dh, page), v_pool.dtype)
+        if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, page), k_scale.dtype)
+        if staged else None,
+        pltpu.VMEM((2, sb, pb, hkv, page), v_scale.dtype)
+        if staged else None,
         pltpu.SemaphoreType.DMA((4, 2, sb, pb)) if dma else None,
     ]
     in_specs = [
@@ -944,10 +995,11 @@ def paged_chunk_attention(
     - ``k_chunk``/``v_chunk``: (S, Hkv, W, Dh) — the chunk's own kv
       projections (NOT yet in the pool; the kernel overlays them, so
       verify needs no tentative pool writes at all).
-    - ``k_pool``/``v_pool``/``k_scale``/``v_scale``: the page pools,
-      bf16 or int8-with-scales, exactly as
-      :func:`paged_decode_attention` takes them; int8 dequantizes
-      inside the kernel, page at a time.
+    - ``k_pool``/``v_pool``/``k_scale``/``v_scale``: the page pools —
+      bf16, int8 with f32 scales, or fp8 (``float8_e4m3fn``) with
+      uint8 E8M0 scales — exactly as :func:`paged_decode_attention`
+      takes them; quantized pools dequantize inside the kernel, page
+      at a time.
     - ``page_table``: (S, P); ``lens``: (S,) committed tokens per slot.
     - ``ctx_len``: static attention width — MUST equal the dense
       oracle's buffer width for the bitwise contract (defaults to
@@ -1017,7 +1069,7 @@ def paged_chunk_attention(
         )
     from beholder_tpu.ops import autotune
 
-    dtype = "int8" if k_scale is not None else str(k_pool.dtype)
+    dtype = pool_dtype_family(k_pool, quantized=k_scale is not None)
     resolved = autotune.resolve_config(
         autotune.shape_key(
             "paged_chunk", slots=slots, width=w, max_pages=max_pages,

@@ -1,4 +1,4 @@
-"""Int8 weight quantization for serving.
+"""Int8 / fp8 quantization for serving.
 
 EXTENSION BEYOND THE REFERENCE (no tensors there — SURVEY.md §0).
 Weight-only, per-output-channel symmetric int8:
@@ -19,6 +19,27 @@ Per-channel scales bound the quantization error: for column j,
 ``scale_j = max_i |w_ij| / 127``, so the roundoff per weight is at most
 ``scale_j / 2`` — outlier columns don't poison the whole matrix the way
 one per-tensor scale would.
+
+KV PAGE quantization comes in two flavors, one definition each:
+
+- **int8** (:func:`quantize_symmetric`): int8 values + f32 per-block
+  scales — 1 byte per element plus 4 scale bytes per (head, token)
+  block.
+- **fp8 shared-exponent** (:func:`quantize_fp8_block`): ``float8_e4m3fn``
+  values + **E8M0** per-block scales — a uint8 biased power-of-2
+  exponent (``scale = 2**(e - 127)``, the MX block format's scale
+  encoding). Values stay 8-bit like int8; what shrinks is the SCALE
+  side-channel (4 bytes → 1 byte per block), and what power-of-2
+  scales buy numerically is EXACTNESS: ``q_f32 * 2**e`` is a float32
+  exponent shift with no mantissa rounding, so every dequant site
+  (kernel, oracle, debug gather) reproduces identical bits by
+  construction — the fused-vs-dense bitwise contract carries over to
+  fp8 pools without any per-site tolerance argument.
+
+:func:`pool_quantize` / :func:`pool_scales_f32` are the ONE dispatch
+pair every pool write / dequant site shares (serving chunk writes,
+decode-tick columns, the paged kernels, the dense oracles): the pool's
+value dtype picks the scheme, the scale dtype picks the decoding.
 """
 
 from __future__ import annotations
@@ -45,6 +66,75 @@ def quantize_symmetric(x: jax.Array, axis: int) -> tuple[jax.Array, jax.Array]:
         -127, 127,
     )
     return q.astype(jnp.int8), scale
+
+
+#: float8_e4m3fn's largest finite value (no inf encoding — hence "fn")
+FP8_MAX = 448.0
+
+#: E8M0 exponent bias (scale = 2**(int(e) - 127), e stored uint8)
+E8M0_BIAS = 127
+
+
+def quantize_fp8_block(
+    x: jax.Array, axis: int
+) -> tuple[jax.Array, jax.Array]:
+    """Shared-exponent fp8 block quantization reducing ``axis``:
+    returns (q ``float8_e4m3fn``, e8m0 scales uint8 with ``axis``
+    removed), ``x ≈ q_f32 * 2**(e - 127)``.
+
+    The block scale is the smallest power of two bringing the block's
+    amax inside fp8 range (``amax / 2**e <= 448``), clamped to f32's
+    exact-exponent window so the dequant multiply is a pure exponent
+    shift — see the module docstring for why that exactness is the
+    point. An all-zero block gets the identity scale (e = bias)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis)
+    # ceil(log2(amax / 448)) via frexp (exact — no transcendental
+    # rounding at block boundaries): amax = m * 2**exp, m in [0.5, 1),
+    # so 2**e >= amax/448 first holds at e = exp - 9, +1 when the
+    # mantissa still spills (m * 2**9 > 448, i.e. m > 0.875)
+    m, exp = jnp.frexp(jnp.maximum(amax, jnp.float32(1e-30)))
+    e = exp - 9 + (m > jnp.float32(0.875)).astype(exp.dtype)
+    e = jnp.where(amax > 0, e, 0)
+    e = jnp.clip(e, -E8M0_BIAS + 1, E8M0_BIAS)  # f32-exact scale range
+    inv = jnp.exp2(-e.astype(jnp.float32))
+    q = jnp.clip(
+        xf * jnp.expand_dims(inv, axis), -FP8_MAX, FP8_MAX
+    ).astype(jnp.float8_e4m3fn)
+    return q, (e + E8M0_BIAS).astype(jnp.uint8)
+
+
+def pool_quantize(
+    x: jax.Array, axis: int, values_dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize a KV block for a pool of ``values_dtype`` — the ONE
+    dispatch every pool write shares (admit chunk scatters and
+    decode-tick column writes must quantize identically or paged-vs-
+    dense equivalence breaks)."""
+    if values_dtype == jnp.int8:
+        return quantize_symmetric(x, axis)
+    if values_dtype == jnp.float8_e4m3fn:
+        return quantize_fp8_block(x, axis)
+    raise ValueError(f"no pool quantizer for {values_dtype}")
+
+
+def pool_scales_f32(scales: jax.Array) -> jax.Array:
+    """Decode a pool's per-block scales to f32 multipliers: f32 scales
+    (int8 pools) pass through; uint8 scales are E8M0 biased exponents
+    (fp8 pools) — ``2**(e - 127)``, exact in f32 across the clamped
+    range :func:`quantize_fp8_block` emits. Every dequant site (both
+    paged-kernel transports, the dense oracles, debug gathers) must
+    decode through here so the arithmetic cannot drift."""
+    if scales.dtype == jnp.uint8:
+        # 2**(e - 127) EXACTLY: e is the f32 exponent FIELD, so build
+        # the float from its bits (exp2() is a transcendental on some
+        # backends and lands 1 ulp off for negative exponents, which
+        # would silently break the exact-shift contract above).
+        # quantize_fp8_block clamps e to [1, 254] — always a normal
+        return jax.lax.bitcast_convert_type(
+            scales.astype(jnp.uint32) << 23, jnp.float32
+        )
+    return scales
 
 
 def quantize_weight(w: jax.Array) -> dict[str, jax.Array]:

@@ -275,6 +275,33 @@ class BeholderService:
         self.autotune_table = config.get(
             "instance.serving.autotune.table", None
         )
+        #: KV page encoding (``instance.serving.cache_dtype``; "bf16"
+        #: by default): "int8" halves KV value bytes, "fp8" shrinks the
+        #: scale side-channel further (float8_e4m3fn values + uint8
+        #: E8M0 scales) — parsed here import-light as a STRING; the
+        #: embedder passes it to ``ContinuousBatcher(cache_dtype=
+        #: service.cache_dtype)``, where init_paged maps the spelling
+        #: to the pool encoding. "bf16" serves byte-identically to the
+        #: pre-knob batcher.
+        cache_dtype = str(
+            config.get("instance.serving.cache_dtype", "bf16")
+        )
+        if cache_dtype not in ("bf16", "int8", "fp8"):
+            raise ValueError(
+                f"instance.serving.cache_dtype must be one of "
+                f"bf16/int8/fp8, got {cache_dtype!r}"
+            )
+        self.cache_dtype = cache_dtype
+        #: fused wave prefill (``instance.serving.fused_wave``; OFF by
+        #: default): run_waves admits each wave through the fused chunk
+        #: kernel instead of dense per-wave context buffers — same
+        #: import-light contract as ``fused_verify`` (the embedder
+        #: passes ``ContinuousBatcher(fused_wave=service.fused_wave)``;
+        #: bitwise-identical deltas either way, pinned by
+        #: tests/test_serving.py).
+        self.fused_wave = bool(
+            config.get("instance.serving.fused_wave", False)
+        )
 
         #: optional request-level SLO engine (``instance.slo.*``; OFF
         #: by default ⇒ serving output and the default exposition stay
@@ -391,6 +418,12 @@ class BeholderService:
                 registry=self.metrics.registry,
                 flight_recorder=self.flight_recorder,
             )
+        #: daemon-owned periodic autoscaler clock (``instance.control.
+        #: autoscale.evaluator_interval_s``; OFF by default — None here
+        #: means evaluation stays purely boundary-driven). Built and
+        #: started by :meth:`start_scaling_evaluator` once the embedder
+        #: has attached ``cluster_scheduler``; stopped in :meth:`close`.
+        self.scaling_evaluator = None
 
         if self.flight_plane is not None:
             # trace-context propagation, OUTERMOST on the transport
@@ -575,11 +608,48 @@ class BeholderService:
 
         return traced_handler
 
+    def start_scaling_evaluator(self):
+        """Start the periodic autoscaler evaluator thread, if armed.
+
+        Call AFTER attaching ``cluster_scheduler`` (the evaluator
+        drives ``control_plane.evaluate_scaling(scheduler)``). Returns
+        the running :class:`~beholder_tpu.control.evaluator.
+        ScalingEvaluator`, or None when any prerequisite is off — the
+        control plane, the autoscale actuator, the
+        ``evaluator_interval_s`` knob, or the scheduler itself (all
+        default-off: no knob, no thread, byte-identical daemon)."""
+        if self.scaling_evaluator is not None:
+            return self.scaling_evaluator
+        cfg = getattr(self.control, "autoscale", None)
+        if (
+            self.control_plane is None
+            or cfg is None
+            or cfg.evaluator_interval_s is None
+            or self.cluster_scheduler is None
+        ):
+            return None
+        from beholder_tpu.control.evaluator import ScalingEvaluator
+
+        self.scaling_evaluator = ScalingEvaluator(
+            self.control_plane,
+            self.cluster_scheduler,
+            cfg.evaluator_interval_s,
+            logger=self.logger,
+        ).start()
+        return self.scaling_evaluator
+
     def close(self) -> None:
         """Graceful teardown: stop consuming, drain analytics, flush the
         observability tail (open spans, raw observations, the flight-
         recorder ring), close."""
         self.logger.info("shutting down")
+        if self.scaling_evaluator is not None:
+            # the autoscaler clock stops before the drain below: a
+            # scale decision racing teardown helps nobody
+            try:
+                self.scaling_evaluator.stop()
+            except Exception:  # noqa: BLE001 - best effort on the way out
+                pass
         self.broker.close()
         # graceful cluster drain (SIGTERM routes here): stop admitting
         # and serve what's queued, so a decommission loses nothing

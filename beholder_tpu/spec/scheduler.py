@@ -502,11 +502,14 @@ def _run_spec_loop(
         if fr is not None and active.any():
             # each live slot scores a (k+1)-wide chunk against its
             # paged context — the "verify" kernel family, or
-            # "paged_chunk" when the fused kernel serves it (its own
-            # roofline family: the flight recorder and perf gate see
-            # the fused kernel's achieved ceiling fraction separately)
+            # "paged_chunk:<family>" when the fused kernel serves it
+            # (dtype-qualified so each pool encoding's achieved ceiling
+            # fraction reaches the flight recorder and perf gate as its
+            # own series — fp8 dequant rides a different roofline than
+            # bf16 loads)
             verify_tags.update(batcher._kernel_tags(
-                "paged_chunk" if fused else "verify",
+                f"paged_chunk:{batcher.pool_family}" if fused
+                else "verify",
                 float(active.sum()) * w * batcher._flops_per_token(
                     float(cache_len[active].mean())
                 ),

@@ -63,11 +63,14 @@ from beholder_tpu.ops.paged_attention import (
 def _gather_dense(pool, page_table: jax.Array) -> jax.Array:
     """(num_pages, Hkv, Dh, page) pool rows -> (slots, Hkv, P*page, Dh)
     dense bf16 contexts via each slot's page table row (dequantized
-    under int8 pools) — the batched twin of ``paged_admit_with_prefix``'s
-    single-slot gather."""
+    under quantized pools) — the batched twin of
+    ``paged_admit_with_prefix``'s single-slot gather."""
     if isinstance(pool, QuantizedPool):
+        from beholder_tpu.ops.quant import pool_scales_f32
+
         vals = (
-            pool.values.astype(jnp.float32) * pool.scales[:, :, None, :]
+            pool.values.astype(jnp.float32)
+            * pool_scales_f32(pool.scales)[:, :, None, :]
         ).astype(jnp.bfloat16)
     else:
         vals = pool.astype(jnp.bfloat16)

@@ -45,6 +45,13 @@ ratio``                     victim p95 / flood p95 under control —    higher
 ratio``                     vault-armed / plain serving wall,         higher
                             slope-timed interleaved in the same
                             session — host speed divides out
+``capacity_admitted_
+ratio``                     fp8 admitted / int8 admitted on pools     lower
+                            holding the SAME HBM byte budget — pure
+                            admission accounting, host-independent
+``fused_wave_ratio``        fused-wave / dense-wave run_waves wall,   higher
+                            interleaved in the same session after a
+                            bitwise stream assert — host divides out
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -155,6 +162,20 @@ NOISE_BANDS: dict[str, float] = {
     # scheduler jitter around ~1x. Same interleaved-ratio width as
     # fused_verify_ratio
     "retention_overhead_ratio": 0.40,
+    # fp8-admitted / int8-admitted on pools holding the same HBM byte
+    # budget (schema v14): pure admission accounting — no walls at all,
+    # so host speed is irrelevant and the figure is near-deterministic
+    # (page geometry + the replayed request mix). The band only absorbs
+    # request-mix tweaks between rounds; degradation = the ratio
+    # FALLING toward 1.0 (fp8's scale side-channel no longer buying
+    # pages over int8's f32 scales)
+    "capacity_admitted_ratio": 0.10,
+    # fused-wave / dense-wave run_waves wall (schema v14): both engines
+    # interleaved in the same session on the same request replay, after
+    # asserting their streams bitwise-equal — host drift divides out.
+    # Same interleaved-ratio width as fused_verify_ratio; what it must
+    # catch is the fused wave lane losing its edge, not jitter
+    "fused_wave_ratio": 0.40,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -280,6 +301,20 @@ def _retention_overhead(artifact: dict) -> float | None:
     return float(value)
 
 
+def _capacity_admitted_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "capacity", "capacity_admitted_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v14 artifact / capacity scenario not run
+    return float(value)
+
+
+def _fused_wave_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "capacity", "fused_wave_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v14 artifact / capacity scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -314,6 +349,12 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # vault-armed/plain serving wall: a retention-cost regression shows
     # as the ratio RISING away from "cheap enough to leave on"
     ("retention_overhead_ratio", _retention_overhead, "higher"),
+    # fp8/int8 admitted on a matched byte budget: the capacity win
+    # eroding shows as the ratio FALLING toward 1.0
+    ("capacity_admitted_ratio", _capacity_admitted_ratio, "lower"),
+    # fused-wave/dense-wave serving wall: the fused lane losing its
+    # edge shows as the ratio RISING back toward the dense program
+    ("fused_wave_ratio", _fused_wave_ratio, "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -401,6 +442,20 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "retention_incidents",
         lambda a: _get(a, "retention", "incidents"),
+    ),
+    # capacity evidence behind capacity_admitted_ratio: raw admission
+    # counts are pool-geometry/workload-dependent, reported only
+    (
+        "capacity_admitted_fp8",
+        lambda a: _get(a, "capacity", "admitted_fp8"),
+    ),
+    (
+        "capacity_admitted_int8",
+        lambda a: _get(a, "capacity", "admitted_int8"),
+    ),
+    (
+        "capacity_admitted_bf16",
+        lambda a: _get(a, "capacity", "admitted_bf16"),
     ),
 ]
 

@@ -1,0 +1,106 @@
+"""Capacity-per-chip artifact + gate contracts (ISSUE 17): the v14
+``capacity`` block (matched-HBM-budget admission counts for
+bf16/int8/fp8 pools plus the fused-wave wall ratio), its validation,
+and the two perf-gate bands riding on it —
+``capacity_admitted_ratio`` (lower fails: fp8 must keep admitting
+MORE than int8 on the same byte budget) and ``fused_wave_ratio``
+(higher fails: the fused wave lane may not get slower relative to
+the dense wave program)."""
+
+import pytest
+
+from beholder_tpu import artifact
+from beholder_tpu.tools import perf_gate
+
+
+# -- artifact schema v14: the capacity block ---------------------------------
+
+
+def test_artifact_v14_capacity_block_roundtrip(tmp_path):
+    rec = artifact.ArtifactRecorder("bench_test")
+    assert rec.capacity == artifact.EMPTY_CAPACITY
+    rec.record_capacity({
+        "admitted_bf16": 42.0, "admitted_int8": 68.0,
+        "admitted_fp8": 80.0, "capacity_admitted_ratio": 80.0 / 68.0,
+        "fused_wave_ratio": 1.02, "budget_mib": 0.5,
+    })
+    path = rec.write(str(tmp_path / "a.json"))
+    obj = artifact.validate_file(path)
+    assert obj["schema_version"] >= 14
+    assert obj["capacity"]["admitted_fp8"] == 80.0
+    assert obj["capacity"]["capacity_admitted_ratio"] == pytest.approx(
+        80.0 / 68.0
+    )
+
+
+def test_artifact_v14_rejects_missing_keys():
+    rec = artifact.ArtifactRecorder("bench_test")
+    with pytest.raises(ValueError, match="capacity summary missing"):
+        rec.record_capacity({"admitted_bf16": 1.0, "admitted_int8": 2.0})
+    assert rec.capacity == artifact.EMPTY_CAPACITY
+
+
+# -- the perf-gate bands -----------------------------------------------------
+
+
+def _gate_artifact(cap_ratio=80.0 / 68.0, wave=1.02):
+    rec = artifact.ArtifactRecorder("bench_gate")
+    rec.record_raw("x", "trial_wall", [0.1])
+    rec.record_capacity({
+        "admitted_bf16": 42.0, "admitted_int8": 68.0,
+        "admitted_fp8": 68.0 * cap_ratio,
+        "capacity_admitted_ratio": cap_ratio,
+        "fused_wave_ratio": wave, "budget_mib": 0.5,
+    })
+    return rec.to_dict()
+
+
+def test_perf_gate_bands_capacity_admitted_ratio():
+    base = _gate_artifact()
+    verdict = perf_gate.run_gate(base, _gate_artifact())
+    assert verdict["verdict"] == "pass"
+    assert "capacity_admitted_ratio" in {
+        c["metric"] for c in verdict["checks"]
+    }
+    # the fp8 capacity win shrinking past the band -> fail (lower
+    # fails: this ratio is the headline the PR pins)
+    verdict = perf_gate.run_gate(base, _gate_artifact(cap_ratio=1.0))
+    assert "capacity_admitted_ratio" in verdict["failed"]
+    # admitting even more is never a failure (one-sided)
+    assert perf_gate.run_gate(
+        base, _gate_artifact(cap_ratio=1.5)
+    )["verdict"] == "pass"
+    # raw admission counts are reported absolute, never gated
+    reported = perf_gate.run_gate(base, _gate_artifact())[
+        "reported_not_gated"
+    ]
+    assert reported["capacity_admitted_fp8"]["current"] == pytest.approx(
+        80.0
+    )
+    assert reported["capacity_admitted_int8"]["current"] == 68.0
+
+
+def test_perf_gate_bands_fused_wave_ratio():
+    base = _gate_artifact()
+    verdict = perf_gate.run_gate(base, _gate_artifact())
+    assert "fused_wave_ratio" in {c["metric"] for c in verdict["checks"]}
+    # the fused lane getting slower vs the dense wave -> fail
+    verdict = perf_gate.run_gate(base, _gate_artifact(wave=1.6))
+    assert "fused_wave_ratio" in verdict["failed"]
+    # getting faster is never a failure (higher-fails, one-sided)
+    assert perf_gate.run_gate(
+        base, _gate_artifact(wave=0.7)
+    )["verdict"] == "pass"
+
+
+def test_perf_gate_skips_capacity_when_absent():
+    # a capacity-less artifact (pre-v14, or a run that never ran the
+    # scenario) skips both bands, never fails
+    rec = artifact.ArtifactRecorder("bench_nocap")
+    rec.record_raw("x", "trial_wall", [0.1])
+    empty = rec.to_dict()
+    verdict = perf_gate.run_gate(empty, empty)
+    assert verdict["verdict"] == "pass"
+    skipped = {s["metric"] for s in verdict["skipped"]}
+    assert "capacity_admitted_ratio" in skipped
+    assert "fused_wave_ratio" in skipped

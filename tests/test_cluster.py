@@ -223,7 +223,7 @@ def test_colocated_cluster_bitwise_identical_and_zero_horizon(model_state):
 # -- the handoff's byte-for-byte pool contract -------------------------------
 
 
-@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8", "fp8"])
 def test_handoff_preserves_page_content_byte_for_byte(
     model_state, cache_dtype
 ):
@@ -244,7 +244,9 @@ def test_handoff_preserves_page_content_byte_for_byte(
     from beholder_tpu.ops import NUM_STATUSES
 
     model, state = model_state
-    dtype = jnp.int8 if cache_dtype == "int8" else jnp.bfloat16
+    dtype = {"int8": jnp.int8, "fp8": "fp8"}.get(
+        cache_dtype, jnp.bfloat16
+    )
     page, t = 8, 13
     rng = np.random.default_rng(7)
     feats = rng.normal(0, 1, (t, 1 + NUM_STATUSES)).astype(np.float32)

@@ -568,7 +568,7 @@ def test_drain_requires_failover_and_survivors(model_state):
     assert solo.failover.state("decode-0") == "up"  # rolled back
 
 
-@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8", "fp8"])
 def test_migrate_pool_live_slots_byte_identical(model_state, cache_dtype):
     """The live-slot migration primitive: active slots (including a
     refcount-shared fork) move across a real device hop with
@@ -585,7 +585,9 @@ def test_migrate_pool_live_slots_byte_identical(model_state, cache_dtype):
     from beholder_tpu.ops import NUM_STATUSES
 
     model, state = model_state
-    dtype = jnp.int8 if cache_dtype == "int8" else jnp.bfloat16
+    dtype = {"int8": jnp.int8, "fp8": "fp8"}.get(
+        cache_dtype, jnp.bfloat16
+    )
     kw = dict(BATCHER_KW, slots=4, cache_dtype=dtype)
     devs = jax.devices()
 

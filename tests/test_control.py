@@ -1042,3 +1042,105 @@ def test_committed_bench_control_artifact_is_live():
     assert 0 < control["victim_ttft_ratio"] < 1.0
     assert control["k_shed_events"] > 0
     assert control["scale_events"] > 0
+
+
+# -- the periodic evaluator thread (the autoscaler's clock) ------------------
+
+
+class _FakePlane:
+    """Counts evaluate_scaling calls; raises on the listed call
+    numbers (1-based) to exercise the swallow-and-count contract."""
+
+    def __init__(self, fail_at=()):
+        self.calls = 0
+        self.fail_at = set(fail_at)
+
+    def evaluate_scaling(self, scheduler):
+        self.calls += 1
+        if self.calls in self.fail_at:
+            raise RuntimeError("boom")
+        return {"direction": "up", "call": self.calls}
+
+
+def test_scaling_evaluator_poll_once_counts_and_swallows():
+    from beholder_tpu.control.evaluator import ScalingEvaluator
+
+    class _Log:
+        def __init__(self):
+            self.exceptions = 0
+
+        def exception(self, *a, **k):
+            self.exceptions += 1
+
+    log = _Log()
+    plane = _FakePlane(fail_at={2})
+    ev = ScalingEvaluator(plane, scheduler=object(), interval_s=1.0,
+                          logger=log)
+    assert ev.poll_once() == {"direction": "up", "call": 1}
+    # a failing evaluation is counted + logged, never raised — the
+    # evaluator may not take the daemon down
+    assert ev.poll_once() is None
+    assert ev.poll_once() == {"direction": "up", "call": 3}
+    assert ev.evaluations == 3
+    assert ev.errors == 1
+    assert log.exceptions == 1
+
+
+def test_scaling_evaluator_thread_ticks_deterministically():
+    import time
+
+    from beholder_tpu.control.evaluator import ScalingEvaluator
+
+    waits = []
+    plane = _FakePlane()
+    ev = ScalingEvaluator(
+        plane, scheduler=object(), interval_s=0.25,
+        # the injected wait steps the loop: three ticks, then "stop"
+        wait=lambda t: waits.append(t) or len(waits) > 3,
+    )
+    assert ev.start() is ev
+    deadline = time.monotonic() + 5.0
+    while ev.running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not ev.running
+    assert plane.calls == 3 and ev.evaluations == 3
+    assert waits == [0.25] * 4  # every sleep used the interval
+    ev.stop()  # idempotent after the thread already exited
+    ev.stop()
+
+
+def test_scaling_evaluator_stop_wakes_immediately():
+    import time
+
+    from beholder_tpu.control.evaluator import ScalingEvaluator
+
+    ev = ScalingEvaluator(_FakePlane(), scheduler=object(),
+                          interval_s=3600.0)
+    ev.stop()  # no-op before start
+    ev.start()
+    assert ev.start() is ev  # idempotent while running
+    assert ev.running
+    t0 = time.monotonic()
+    ev.stop()  # the stop event's own wait: no hour-long sleep-out
+    assert time.monotonic() - t0 < 5.0
+    assert not ev.running
+    with pytest.raises(ValueError, match="interval_s"):
+        ScalingEvaluator(_FakePlane(), scheduler=object(), interval_s=0)
+
+
+def test_scaling_evaluator_drives_the_real_plane(model_state):
+    from beholder_tpu.control.evaluator import ScalingEvaluator
+
+    model, state = model_state
+    sched, plane, tracker, clock = _scaling_fixture(model, state)
+    ev = ScalingEvaluator(plane, sched, interval_s=0.5)
+    for _ in range(10):
+        tracker.observe(5.0)  # burning
+    for i in range(4):
+        sched.submit(make_request(i, 8, 4))  # pool pressure
+    assert ev.poll_once() is None  # arms the sustain window
+    clock[0] += 1.5
+    event = ev.poll_once()  # identical decision to a router boundary
+    assert event is not None and event["direction"] == "up"
+    assert len(sched.shards) == 2
+    assert ev.evaluations == 2 and ev.errors == 0

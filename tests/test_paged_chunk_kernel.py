@@ -36,6 +36,7 @@ from beholder_tpu.ops.paged_attention import (
     QuantizedPool,
     paged_chunk_attention,
 )
+from beholder_tpu.ops.quant import pool_scales_f32
 from beholder_tpu.proto import TelemetryStatusEntry
 from beholder_tpu.spec import SpecConfig
 from beholder_tpu.spec.drafter import Drafter, NullDrafter
@@ -98,7 +99,8 @@ def _dense_oracle(q, kc, vc, k_pool, v_pool, table, lens, *, ctx_len,
     def gather(pool, scales):
         if scales is not None:
             vals = (
-                pool.astype(jnp.float32) * scales[:, :, None, :]
+                pool.astype(jnp.float32)
+                * pool_scales_f32(scales)[:, :, None, :]
             ).astype(jnp.bfloat16)
         else:
             vals = pool.astype(jnp.bfloat16)
@@ -159,6 +161,18 @@ def _kernel_inputs(seed, *, slots=4, hkv=2, g=2, w=4, dh=16, page=PAGE,
     lens = jax.random.randint(
         keys[4], (slots,), 0, max_pages * page - w, jnp.int32
     )
+    if quant == "fp8":
+        # e4m3 values + E8M0 exponent-byte scales (the fp8 page layout)
+        kp = jax.random.normal(
+            keys[5], (num_pages, hkv, dh, page)
+        ).astype(jnp.float8_e4m3fn)
+        vp = jax.random.normal(
+            keys[6], (num_pages, hkv, dh, page)
+        ).astype(jnp.float8_e4m3fn)
+        ks = jax.random.randint(
+            keys[7], (num_pages, hkv, page), 119, 135, jnp.uint8
+        )
+        return q, kc, vc, kp, vp, table, lens, ks, ks
     if quant:
         kp = jax.random.randint(
             keys[5], (num_pages, hkv, dh, page), -127, 128, jnp.int8
@@ -179,7 +193,8 @@ def _kernel_inputs(seed, *, slots=4, hkv=2, g=2, w=4, dh=16, page=PAGE,
     return q, kc, vc, kp, vp, table, lens, None, None
 
 
-@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("quant", [False, "int8", "fp8"],
+                         ids=["bf16", "int8", "fp8"])
 def test_kernel_bitwise_vs_dense_oracle(quant):
     """THE kernel contract: paged_chunk_attention == the dense-gather
     oracle BITWISE (np.array_equal, not allclose) — GQA, random
@@ -202,7 +217,8 @@ def test_kernel_bitwise_vs_dense_oracle(quant):
         )
 
 
-@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("quant", [False, "int8", "fp8"],
+                         ids=["bf16", "int8", "fp8"])
 def test_pallas_transport_matches_reference(monkeypatch, quant):
     """The pallas kernel body (what a real TPU compiles, run here in
     interpreter mode via FORCE_PALLAS_INTERPRET) is bitwise the
@@ -225,7 +241,8 @@ def test_pallas_transport_matches_reference(monkeypatch, quant):
     np.testing.assert_array_equal(got, ref)
 
 
-@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8"])
+@pytest.mark.parametrize("quant", [False, "int8", "fp8"],
+                         ids=["bf16", "int8", "fp8"])
 @pytest.mark.parametrize("windowed", [False, True], ids=["full", "window"])
 def test_pallas_dma_assembly_matches_reference(monkeypatch, quant,
                                                windowed):
@@ -797,17 +814,18 @@ def test_service_parses_serving_knobs():
 
 def test_fused_verify_round_tagged_paged_chunk_family(model_and_params):
     """With the flight recorder armed, fused verify rounds carry the
-    'paged_chunk' kernel family (their own roofline series for the
-    perf gate), dense rounds keep 'verify'."""
+    dtype-qualified 'paged_chunk:<family>' kernel family (each pool
+    encoding its own roofline series for the perf gate), dense rounds
+    keep 'verify'."""
     from beholder_tpu.obs import FlightRecorder
 
     model, params = model_and_params
 
-    def families(fused):
+    def families(fused, **kw):
         fr = FlightRecorder(ring_size=512)
         b = _batcher(
             model, params, spec=SpecConfig(max_draft=3),
-            fused_verify=fused, flight_recorder=fr,
+            fused_verify=fused, flight_recorder=fr, **kw,
         )
         b.run_spec([_request(0, horizon=6)])
         return {
@@ -816,7 +834,9 @@ def test_fused_verify_round_tagged_paged_chunk_family(model_and_params):
             if e.get("name") == "verify"
         } - {None}
 
-    assert families(True) == {"paged_chunk"}
+    assert families(True) == {"paged_chunk:bf16"}
+    assert families(True, cache_dtype="int8") == {"paged_chunk:int8"}
+    assert families(True, cache_dtype="fp8") == {"paged_chunk:fp8"}
     assert families(False) == {"verify"}
 
 
@@ -862,6 +882,51 @@ def test_autotune_missing_or_malformed_table_is_empty(tmp_path):
     bad.write_text("{not json")
     autotune.configure(str(bad))
     assert autotune.resolve_config("anything") == autotune.DEFAULTS
+
+
+def test_autotune_malformed_table_is_loud_once(tmp_path):
+    """A corrupt COMMITTED table serves DEFAULTS but reports it: one
+    ``autotune.table_bad`` instant per path per process on the armed
+    flight recorder (re-reads stay quiet — the retry on every
+    configure() must not spam)."""
+    from beholder_tpu.obs import FlightRecorder
+
+    fr = FlightRecorder(ring_size=16)
+    autotune.set_recorder(fr)
+    try:
+        bad = tmp_path / "corrupt.json"
+        bad.write_text('{"schema": "beholder-autotune-table"')  # truncated
+        autotune.configure(str(bad))
+        assert autotune.resolve_config("anything") == autotune.DEFAULTS
+        events = [
+            e for e in fr.events() if e["name"] == "autotune.table_bad"
+        ]
+        assert len(events) == 1
+        assert events[0]["args"]["path"] == str(bad)
+        assert events[0]["args"]["error"]
+        # the SAME path re-read is quiet (warn-once per process)
+        autotune.configure(str(bad))
+        assert autotune.resolve_config("anything") == autotune.DEFAULTS
+        assert (
+            len([
+                e for e in fr.events()
+                if e["name"] == "autotune.table_bad"
+            ]) == 1
+        )
+        # a DIFFERENT corrupt path is its own loud event (parses as
+        # JSON but is not a table — malformed, not absent)
+        bad2 = tmp_path / "corrupt2.json"
+        bad2.write_text("[1, 2, 3]")
+        autotune.configure(str(bad2))
+        assert autotune.resolve_config("anything") == autotune.DEFAULTS
+        assert (
+            len([
+                e for e in fr.events()
+                if e["name"] == "autotune.table_bad"
+            ]) == 2
+        )
+    finally:
+        autotune.set_recorder(None)
 
 
 def test_autotune_normalize_divisors_and_transient_cap():
@@ -930,10 +995,17 @@ def test_autotune_validate_table_errors():
 
 
 def test_committed_autotune_table_is_valid():
+    """The committed table is schema v2 with MEASURED entries for every
+    dtype family the serving layer can key by — the CI artifact gate's
+    per-family assertion, pinned here too."""
     with open(autotune.DEFAULT_TABLE_PATH) as f:
         table = json.load(f)
     autotune.validate_table(table)
-    assert table["entries"], "committed table must carry entries"
+    assert table["schema_version"] >= 2
+    for family in autotune.FAMILIES:
+        assert table["families"].get(family), (
+            f"committed table must carry measured {family} entries"
+        )
 
 
 # -- artifact v9 + perf gate --------------------------------------------------

@@ -110,3 +110,69 @@ def test_quantize_params_preserves_list_containers():
     np.testing.assert_allclose(
         np.asarray(deq["layers"][1]["kernel"]), 2.0, rtol=1e-2
     )
+
+
+# -- fp8 KV-page quantization (shared-exponent e4m3 blocks) ------------------
+
+
+def test_fp8_block_roundtrip_error_bounded():
+    """The fp8 KV contract: per-block relative error <= 2**-4 of the
+    block amax (e4m3's 3 mantissa bits), the scaled amax inside e4m3
+    range (<= 448), and the E8M0 scale an EXACT power of two — the
+    dequant multiply is a pure exponent shift, never a rounding
+    multiply. Wide per-block scale spread (1e-3..1e3) exercises the
+    shared-exponent selection across the whole clip window."""
+    from beholder_tpu.ops.quant import (
+        E8M0_BIAS,
+        FP8_MAX,
+        pool_scales_f32,
+        quantize_fp8_block,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 4, 16)) * jnp.exp(
+        jax.random.normal(jax.random.PRNGKey(1), (32, 4, 1)) * 3.0
+    )
+    q, e = quantize_fp8_block(x, axis=-1)
+    assert q.dtype == jnp.float8_e4m3fn and e.dtype == jnp.uint8
+    assert e.shape == (32, 4)
+
+    scale = pool_scales_f32(e)
+    # scale is exp2 of an integer: multiplying by it shifts exponents
+    np.testing.assert_array_equal(
+        np.asarray(scale), np.exp2(np.asarray(e, np.int32) - E8M0_BIAS)
+    )
+    scaled_amax = np.max(
+        np.abs(np.asarray(x, np.float32))
+        / np.asarray(scale)[:, :, None],
+        axis=-1,
+    )
+    assert np.all(scaled_amax <= FP8_MAX)
+
+    deq = np.asarray(q, np.float32) * np.asarray(scale)[:, :, None]
+    err = np.abs(deq - np.asarray(x, np.float32))
+    amax = np.max(np.abs(np.asarray(x, np.float32)), axis=-1)
+    # e4m3: 3 mantissa bits, block amax scaled into [224, 448] ->
+    # worst ulp over the block is amax * 2**-4
+    assert np.all(err <= amax[:, :, None] * 2.0**-4 + 1e-9)
+
+
+def test_fp8_block_zero_and_identity_scale():
+    """All-zero blocks take the identity scale (e = bias) and
+    round-trip exactly; pool_quantize dispatches by values dtype."""
+    from beholder_tpu.ops.quant import E8M0_BIAS, pool_quantize
+
+    z = jnp.zeros((3, 5))
+    q, e = pool_quantize(z, axis=-1, values_dtype=jnp.float8_e4m3fn)
+    assert q.dtype == jnp.float8_e4m3fn
+    np.testing.assert_array_equal(np.asarray(e), E8M0_BIAS)
+    np.testing.assert_array_equal(np.asarray(q, np.float32), 0.0)
+
+    qi, si = pool_quantize(
+        jnp.ones((2, 4)), axis=-1, values_dtype=jnp.int8
+    )
+    assert qi.dtype == jnp.int8 and si.dtype == jnp.float32
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="no pool quantizer"):
+        pool_quantize(z, axis=-1, values_dtype=jnp.float16)

@@ -241,3 +241,81 @@ def test_progress_undecodable_body_acked(rig):
     broker.publish(PROGRESS_TOPIC, b"\xff\xff\xff not a proto")
     assert broker.in_flight == 0
     assert transport.requests == []
+
+
+# -- capacity-per-chip knobs (instance.serving.*) ----------------------------
+
+
+def _quiet_service(data):
+    return BeholderService(
+        ConfigNode(data), InMemoryBroker(), MemoryStorage(),
+        transport=RecordingTransport(),
+    )
+
+
+def test_serving_capacity_knobs_default_off():
+    service = _quiet_service(make_config().to_dict())
+    # bf16 pages + dense wave prefill: byte-identical to the pre-knob
+    # batcher (pinned in tests/test_serving.py)
+    assert service.cache_dtype == "bf16"
+    assert service.fused_wave is False
+    # no control plane -> no evaluator thread, ever
+    assert service.start_scaling_evaluator() is None
+    assert service.scaling_evaluator is None
+
+
+def test_serving_capacity_knobs_parse():
+    data = make_config().to_dict()
+    data["instance"]["serving"] = {
+        "cache_dtype": "fp8", "fused_wave": True,
+    }
+    service = _quiet_service(data)
+    # parsed import-light as plain values — the embedder hands them to
+    # ContinuousBatcher(cache_dtype=..., fused_wave=...)
+    assert service.cache_dtype == "fp8"
+    assert service.fused_wave is True
+
+
+def test_serving_cache_dtype_rejects_unknown():
+    data = make_config().to_dict()
+    data["instance"]["serving"] = {"cache_dtype": "int4"}
+    with pytest.raises(ValueError, match="cache_dtype"):
+        _quiet_service(data)
+
+
+def test_scaling_evaluator_gated_and_stopped_on_close():
+    data = make_config().to_dict()
+    data["instance"]["control"] = {
+        "enabled": True,
+        "autoscale": {"enabled": True, "evaluator_interval_s": 30.0},
+    }
+    service = _quiet_service(data)
+    assert service.control_plane is not None
+    # armed knob but no scheduler attached yet -> no thread
+    assert service.start_scaling_evaluator() is None
+
+    class _Sched:
+        pass
+
+    service.cluster_scheduler = _Sched()
+    ev = service.start_scaling_evaluator()
+    assert ev is not None and ev.running
+    assert ev.interval_s == 30.0
+    assert service.start_scaling_evaluator() is ev  # idempotent
+    service.close()  # the autoscaler clock stops before the drain
+    assert not ev.running
+
+
+def test_scaling_evaluator_knob_unset_means_no_thread():
+    data = make_config().to_dict()
+    data["instance"]["control"] = {
+        "enabled": True, "autoscale": {"enabled": True},
+    }
+    service = _quiet_service(data)
+
+    class _Sched:
+        pass
+
+    service.cluster_scheduler = _Sched()
+    # evaluator_interval_s unset: evaluation stays boundary-driven
+    assert service.start_scaling_evaluator() is None

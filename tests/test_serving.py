@@ -366,6 +366,86 @@ def test_int8_cache_tracks_bf16_and_halves_bytes():
     assert int8_bytes < 0.65 * bf16_bytes, (int8_bytes, bf16_bytes)
 
 
+def test_fp8_cache_tracks_bf16_and_beats_int8_bytes():
+    """cache_dtype=fp8: forecasts track the bf16-cache batcher within
+    e4m3 quantization tolerance (looser than int8 near the block amax
+    — 3 mantissa bits vs 8 levels-per-scale) and the pool's bytes land
+    strictly UNDER int8's — same 1-byte values, E8M0 exponent-byte
+    scales instead of f32 (the capacity win bench.py --capacity-only
+    pins as admitted requests)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    requests = [_request(i, t=20, horizon=6) for i in range(3)]
+
+    def mk(dtype):
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=16, page_size=8, slots=2, max_prefix=32,
+            max_pages_per_seq=8, cache_dtype=dtype,
+        )
+
+    want = mk(jnp.bfloat16).run_waves(requests)
+    fp8 = mk("fp8")
+    got = fp8.run_waves(requests)
+    for i in range(len(requests)):
+        np.testing.assert_allclose(
+            got[i][:2], want[i][:2], rtol=8e-2, atol=8e-2,
+            err_msg=f"request {i}",
+        )
+
+    def pool_bytes(state):
+        return sum(
+            leaf.nbytes
+            for pool in state.k_pools + state.v_pools
+            for leaf in jax.tree.leaves(pool)
+        )
+
+    int8_bytes = pool_bytes(mk("int8").state)
+    fp8_bytes = pool_bytes(fp8.state)
+    assert fp8_bytes < int8_bytes, (fp8_bytes, int8_bytes)
+
+
+@pytest.mark.parametrize("cache_dtype", [jnp.bfloat16, "fp8"],
+                         ids=["bf16", "fp8"])
+def test_fused_wave_bitwise_matches_dense_wave(cache_dtype):
+    """The fused-wave lane contract: ContinuousBatcher(fused_wave=True)
+    routes wave admission through the fused chunk kernel (no dense
+    per-wave context transient) and its streams are BITWISE the dense
+    wave program's — np.array_equal, not allclose — for plain and
+    quantized pools alike (the fp8 dequant is an exact exponent shift,
+    so the contract survives quantization)."""
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=2)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    requests = [
+        _request(0, t=24, horizon=5),
+        _request(1, t=9, horizon=12),
+        _request(2, t=17, horizon=3),
+        _request(3, t=30, horizon=8),
+    ]
+
+    def mk(fused_wave):
+        return ContinuousBatcher(
+            model, state.params,
+            num_pages=24, page_size=8, slots=2, max_prefix=32,
+            max_pages_per_seq=8, cache_dtype=cache_dtype,
+            fused_wave=fused_wave,
+        )
+
+    dense = mk(False)
+    fused = mk(True)
+    assert fused.fused_wave and not dense.fused_wave
+    want = dense.run_waves(requests)
+    got = fused.run_waves(requests)
+    for i in range(len(requests)):
+        np.testing.assert_array_equal(
+            np.asarray(got[i]), np.asarray(want[i]),
+            err_msg=f"request {i}",
+        )
+    # both engines recycle the pool completely
+    assert int(fused.state.free_top) == 24
+    assert not bool(fused.state.active.any())
+
+
 def test_tick_never_materializes_dense_views():
     """The round-4 claim: the decode tick is paged at COMPUTE time. No
     operation in the tick's jaxpr may produce a dense per-slot cache
@@ -566,8 +646,8 @@ def test_tick_chunk_equals_per_tick_loop():
     )
 
 
-@pytest.mark.parametrize("cache_dtype", [jnp.bfloat16, "int8"],
-                         ids=["bf16", "int8"])
+@pytest.mark.parametrize("cache_dtype", [jnp.bfloat16, "int8", "fp8"],
+                         ids=["bf16", "int8", "fp8"])
 def test_fork_matches_independent_admissions(cache_dtype):
     """paged_fork + teacher-forced ticks == admitting the same request
     into every slot independently. Slot 0's pages are bit-shared with
