@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention bench-capacity perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention bench-capacity bench-fabric perf-gate lint clean
 
 all: proto native
 
@@ -154,6 +154,20 @@ bench-retention:
 bench-capacity:
 	python bench.py --capacity-only
 
+# the cluster-memory-fabric scenario alone: warm-anywhere admission (a
+# shifted replay lands every request on the opposite shard from its
+# warm prefix; cross-shard hits / directory consults is the ratio the
+# perf gate bands, lower fails) plus the interleaved replay-vs-replica
+# recovery comparison (kill-mid-stream served twice per round — re-
+# prefill replay vs dark-standby promotion, both bitwise-asserted;
+# replayed/promoted wall is the second banded ratio). Writes
+# artifacts/bench_fabric.json (schema v15 fabric block); same
+# forced-mesh trick as bench-cluster so the shards and the standby sit
+# on real device boundaries
+bench-fabric:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench.py --fabric-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -182,6 +196,8 @@ perf-gate:
 		--baseline artifacts/bench_retention.json --current artifacts/bench_retention.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_capacity.json --current artifacts/bench_capacity.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_fabric.json --current artifacts/bench_fabric.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

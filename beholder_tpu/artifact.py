@@ -78,7 +78,15 @@ Schema (``validate`` is the authoritative checker)::
                    "admitted_fp8": 0.0,
                    "capacity_admitted_ratio": 0.0,
                    "fused_wave_ratio": 0.0,
-                   "budget_mib": 0.0}  # v14: capacity per chip
+                   "budget_mib": 0.0},  # v14: capacity per chip
+      "fabric": {"cross_shard_lookups": 0.0,
+                 "cross_shard_hits": 0.0,
+                 "cross_shard_prefix_hit_ratio": 0.0,
+                 "pages_fetched": 0.0,
+                 "mirrored_pages": 0.0,
+                 "replayed_recovery_ms": 0.0,
+                 "replica_recovery_ms": 0.0,
+                 "replica_recovery_ratio": 0.0}  # v15: memory fabric
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -199,6 +207,21 @@ side-channel must keep admitting more), and ``fused_wave_ratio``
 (fused-wave / dense-wave run_waves wall, both engines interleaved on
 the same host after a bitwise stream assert; banded like
 ``fused_verify_ratio``). v1-v13 artifacts remain valid.
+
+Schema v15 (the cluster-memory-fabric PR): the run's fabric evidence
+rides along (:meth:`ArtifactRecorder.record_fabric`) — cross-shard
+prefix-index lookups and hits with the derived
+``cross_shard_prefix_hit_ratio`` (hits / lookups on a workload whose
+prefixes are warm ONLY on another shard; the perf gate bands it,
+degradation = the ratio FALLING), pages moved over the fabric and
+mirrored onto the standby, and the failover comparison:
+``replayed_recovery_ms`` (re-prefill replay recovery) vs
+``replica_recovery_ms`` (standby promotion recovery), both measured
+interleaved in the same session after bitwise stream asserts, with
+``replica_recovery_ratio`` (replayed / replica; > 1 means promotion
+recovered faster than replay — the figure the standby mirror exists
+to move; banded, degradation = the ratio FALLING). v1-v14 artifacts
+remain valid.
 """
 
 from __future__ import annotations
@@ -210,7 +233,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -357,6 +380,20 @@ EMPTY_CAPACITY = {
     "budget_mib": 0.0,
 }
 
+#: v15: the fabric block's required shape (an empty block is valid —
+#: a run that never armed the cluster memory fabric still writes a
+#: v15 artifact)
+EMPTY_FABRIC = {
+    "cross_shard_lookups": 0.0,
+    "cross_shard_hits": 0.0,
+    "cross_shard_prefix_hit_ratio": 0.0,
+    "pages_fetched": 0.0,
+    "mirrored_pages": 0.0,
+    "replayed_recovery_ms": 0.0,
+    "replica_recovery_ms": 0.0,
+    "replica_recovery_ratio": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -444,6 +481,7 @@ class ArtifactRecorder:
         self.flight_plane: dict[str, float] = dict(EMPTY_FLIGHT_PLANE)
         self.retention: dict[str, float] = dict(EMPTY_RETENTION)
         self.capacity: dict[str, float] = dict(EMPTY_CAPACITY)
+        self.fabric: dict[str, float] = dict(EMPTY_FABRIC)
 
     def section(
         self,
@@ -672,6 +710,20 @@ class ArtifactRecorder:
             key: float(summary[key]) for key in EMPTY_CAPACITY
         }
 
+    def record_fabric(self, summary: dict[str, Any]) -> None:
+        """Adopt one cluster-memory-fabric summary (bench_fabric's
+        cross-shard hit counters plus the interleaved replay-vs-replica
+        recovery walls) as the run's v15 ``fabric`` block. Last writer
+        wins — the block carries the HEADLINE warm-anywhere admission
+        and promotion-vs-replay comparison, both after bitwise stream
+        asserts."""
+        for key in EMPTY_FABRIC:
+            if key not in summary:
+                raise ValueError(f"fabric summary missing {key!r}")
+        self.fabric = {
+            key: float(summary[key]) for key in EMPTY_FABRIC
+        }
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -723,6 +775,7 @@ class ArtifactRecorder:
             "flight_plane": dict(self.flight_plane),
             "retention": dict(self.retention),
             "capacity": dict(self.capacity),
+            "fabric": dict(self.fabric),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -861,6 +914,14 @@ def record_capacity(summary: dict) -> None:
     :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_capacity(summary)
+
+
+def record_fabric(summary: dict) -> None:
+    """Adopt a cluster-memory-fabric summary into the active
+    recorder's v15 ``fabric`` block; no-op without one (same contract
+    as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_fabric(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -1091,6 +1152,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"capacity.{key} must be a number, "
                         f"got {capacity.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 15:
+        # v15: cluster-memory-fabric evidence
+        fabric = obj.get("fabric")
+        if not isinstance(fabric, dict):
+            problems.append("fabric must be a dict (schema v15+)")
+        else:
+            for key in EMPTY_FABRIC:
+                if not isinstance(fabric.get(key), (int, float)):
+                    problems.append(
+                        f"fabric.{key} must be a number, "
+                        f"got {fabric.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

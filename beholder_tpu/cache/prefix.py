@@ -275,6 +275,35 @@ class PrefixCache:
             self._metrics.cached_pages.set(len(self._entries))
         return True
 
+    def drop_entries(self, keys) -> list[int]:
+        """Forget specific cached chains (cluster fabric: a transient
+        cross-shard borrow whose hit count never reached the
+        replication threshold is dropped right after the serve rather
+        than left to age out of LRU). Tip-first over ``reversed(keys)``
+        so a chain drops leaf-to-root; entries that are pinned
+        (``live_users != 0``), have cached descendants, or are already
+        gone are skipped — same safety posture as :meth:`evict`.
+        Returns the dropped pool page ids; the caller must release the
+        cache's ONE device reference on each."""
+        out: list[int] = []
+        for key in reversed(list(keys)):
+            entry = self._entries.get(key)
+            if (
+                entry is None
+                or entry.live_users != 0
+                or entry.children != 0
+            ):
+                continue
+            del self._entries[key]
+            if entry.parent is not None:
+                parent = self._entries.get(entry.parent)
+                if parent is not None:
+                    parent.children -= 1
+            out.append(entry.page_id)
+        if out and self._metrics is not None:
+            self._metrics.cached_pages.set(len(self._entries))
+        return out
+
     def prefilled(self, n_tokens: int) -> None:
         """Record tokens actually run through the prefill forward."""
         self.prefill_tokens += int(n_tokens)

@@ -37,6 +37,13 @@ submit/run API, same bitwise outputs, N× the pool:
   recovered streams bitwise-identical to an uninterrupted run),
   graceful drain with byte-identical live-page + prefix-pin
   migration, and deadline-aware retirement.
+- **Cluster memory fabric** (:mod:`.fabric`, ``instance.cluster.
+  fabric.*`` — default OFF, under which every shard's prefix cache
+  stays private and failover replays prefill): a cluster-wide prefix
+  index so a prefix warm on one shard is a byte-identical cross-shard
+  page fetch away on every other shard, plus an optional dark standby
+  shard mirroring live pages so failover becomes promotion + pin
+  adoption instead of re-prefill.
 
 **Exactness.** Under exact greedy the cluster emits token streams
 bitwise-identical to the single-device engine on the same request
@@ -109,6 +116,36 @@ class FailoverConfig:
 
 
 @dataclass
+class FabricConfig:
+    """Cluster-memory-fabric knobs (``instance.cluster.fabric.*``).
+
+    None on :class:`ClusterConfig` (the default) keeps each shard's
+    prefix cache private and failover on the replay path. Set, the
+    router arms a :class:`~beholder_tpu.cluster.fabric.engine.
+    FabricEngine`: a cluster-wide prefix index (a prefix warm on shard
+    A is admitted with a prefix hit on shard B via a byte-identical
+    cross-shard page fetch) and, with ``standby``, a dark standby
+    shard that mirrors live pages so failover becomes promotion + pin
+    adoption instead of re-prefill (pinned by ``tests/test_fabric.py``).
+    """
+
+    #: cross-shard hit count at/past which a fetched chain stays
+    #: cached on the borrowing shard as a durable replica; below it
+    #: the borrow is transient and dropped after the serve (hot
+    #: prefixes replicate, cold ones never accumulate copies)
+    replicate_after: int = 2
+    #: keep one dark standby shard mirroring live pages; on a worker
+    #: death the standby is promoted in place of the replay path
+    standby: bool = False
+
+    def __post_init__(self):
+        if self.replicate_after < 1:
+            raise ValueError(
+                f"replicate_after must be >= 1, got {self.replicate_after}"
+            )
+
+
+@dataclass
 class ClusterConfig:
     """Cluster-serving knobs (``instance.cluster.*``).
 
@@ -129,6 +166,9 @@ class ClusterConfig:
     max_pending_pages_per_shard: int | None = None
     #: fault tolerance: None (the default) keeps the fail-stop cluster
     failover: FailoverConfig | None = None
+    #: cluster memory fabric: None (the default) keeps per-shard
+    #: prefix caches private and failover on the replay path
+    fabric: FabricConfig | None = None
 
     def __post_init__(self):
         if self.n_decode_workers < 1:
@@ -175,6 +215,13 @@ def cluster_from_config(config) -> ClusterConfig | None:
                 config.get(f"{fo}.drain_on_sigterm", True)
             ),
         )
+    fabric = None
+    if bool(config.get("instance.cluster.fabric.enabled")):
+        fb = "instance.cluster.fabric"
+        fabric = FabricConfig(
+            replicate_after=int(config.get(f"{fb}.replicate_after", 2)),
+            standby=bool(config.get(f"{fb}.standby", False)),
+        )
     return ClusterConfig(
         n_decode_workers=int(
             config.get("instance.cluster.n_decode_workers", 2)
@@ -192,11 +239,13 @@ def cluster_from_config(config) -> ClusterConfig | None:
             int(max_pages) if max_pages is not None else None
         ),
         failover=failover,
+        fabric=fabric,
     )
 
 
 __all__ = [
     "ClusterConfig",
+    "FabricConfig",
     "FailoverConfig",
     "ROUTE_PRESSURE",
     "ROUTE_ROUND_ROBIN",

@@ -1,0 +1,34 @@
+"""Cluster memory fabric: KV pages as a cluster-wide resource.
+
+Two halves behind ``instance.cluster.fabric.*`` (default OFF ⇒
+serving output, wire bytes, and the /metrics exposition stay
+byte-identical to the fabric-less cluster), sharing one page-movement
+plane built from primitives the repo already pins byte-identical:
+
+- **Global prefix index** (:mod:`.index`): a cluster-wide directory
+  over every shard's radix prefix cache. The chained content hashes
+  are shard-agnostic, so "warm anywhere" is a directory lookup; a
+  prefix warm on shard A admits with a prefix hit on shard B via a
+  verbatim cross-shard page fetch over the transfer engine, with
+  refcount/pin rules extended to cross-shard pins (released on
+  retire/drop/drain/failover) and a borrow-vs-replicate policy for
+  hot prefixes (``replicate_after``).
+- **Standby-replica recovery** (:mod:`.mirror`): a dark standby shard
+  asynchronously mirrors the primaries' cached pages; failover
+  promotes it (:meth:`~.engine.FabricEngine.promote`) so recovery
+  re-admits onto already-resident pages — pin adoption instead of
+  re-prefill.
+
+:mod:`.engine` owns both and is the router's single integration
+surface. This package's host-side half (:mod:`.index`) is
+import-light (no jax), matching the cluster package convention.
+"""
+
+from __future__ import annotations
+
+from .index import GlobalPrefixIndex, IndexedPrefixCache
+
+__all__ = [
+    "GlobalPrefixIndex",
+    "IndexedPrefixCache",
+]

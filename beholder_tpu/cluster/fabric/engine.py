@@ -1,0 +1,504 @@
+"""The fabric engine: cross-shard page movement with one owner.
+
+The router consults this engine at four points, all behind
+``cluster.fabric is not None`` (the default-OFF contract — with no
+engine the cluster is byte-identical to pre-fabric main):
+
+- **Admission** — every attached shard's batcher gets a
+  ``prefix_fetcher`` hook: when the local radix cache cannot cover a
+  request's prefix, the engine asks the :class:`~.index.
+  GlobalPrefixIndex` who can, pins the owner's chain, moves the
+  missing pages verbatim over the transfer engine
+  (:func:`~beholder_tpu.models.serving.paged_export_pages` /
+  :func:`~beholder_tpu.models.serving.paged_import_pages` — the same
+  byte-identical path drain migration rides, so fp8 pools move their
+  int8 values + scales with zero fabric-specific transport code), and
+  adopts them into the borrower's cache so the ordinary local lookup
+  one line later HITS. Bitwise identity falls out: after the fetch the
+  admission is a plain warm hit — same pins, same eviction rules,
+  same page bytes.
+- **Serve completion** (:meth:`finish_serve`) — the borrower's
+  cross-shard pins release against their owners, and borrowed chains
+  whose cross-shard hit count never reached
+  ``FabricConfig.replicate_after`` are dropped (transient borrows;
+  hot prefixes stay as durable replicas).
+- **Worker death** (:meth:`on_worker_down`) / **drain**
+  (:meth:`on_drain`) — the pin ledger and the directory forget the
+  worker (drain repoints pins at the migration target instead —
+  the chains moved there byte-identically, ``live_users`` intact),
+  and a mirroring standby is promoted in place of the replay path.
+- **Between serves** (:meth:`sync`) — the standby mirror refreshes
+  (:class:`~.mirror.StandbyMirror`), spawning a dark standby shard on
+  first use.
+
+Every fabric/mirror hop is tagged with a flight-plane edge id when a
+recorder is armed — ``fabric.send``/``fabric`` and ``mirror.send``/
+``mirror`` pair into Perfetto flow arrows through the same generic
+``*.send`` matching the transfer/drain planes use.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .index import GlobalPrefixIndex, IndexedPrefixCache
+from .mirror import StandbyMirror
+
+
+class FabricEngine:
+    """One cluster's memory fabric: directory + pins + standby."""
+
+    def __init__(self, config, transfer, flight_recorder=None):
+        self.config = config
+        self.transfer = transfer
+        self.flight_recorder = flight_recorder
+        self.index = GlobalPrefixIndex()
+        self.mirror = StandbyMirror(self)
+        #: attached serving shards by pool name (the standby stays
+        #: OUT until promotion — a dark shard must never be a fetch
+        #: owner or a mirror source)
+        self._shards: dict[str, object] = {}
+        #: transient borrows per borrower: chains adopted below the
+        #: replication threshold, dropped at finish_serve
+        self._borrows: dict[str, list[list[bytes]]] = {}
+        #: the dark standby (a router ``_Shard``), or None
+        self.standby = None
+        # host-side counters (bench/tests read these directly; none
+        # registers a metric series — the exposition stays pinned)
+        self.cross_shard_lookups = 0
+        self.cross_shard_hits = 0
+        self.pages_fetched = 0
+        self.fetch_failures = 0
+        self.pins_released = 0
+        self.borrows_dropped = 0
+        self.replicas = 0
+        self.promotions = 0
+        self.standbys_spawned = 0
+        self.standby_failures = 0
+
+    # -- attachment -------------------------------------------------------
+
+    def attach_shard(self, shard) -> None:
+        """Join one serving shard to the fabric: wrap its prefix cache
+        so the directory tracks every index mutation (publishing
+        whatever the cache already holds), and arm the batcher's
+        admission hook. A shard with no prefix cache has nothing to
+        share and stays un-attached."""
+        batcher = shard.batcher
+        if batcher.prefix_cache is None:
+            return
+        name = shard.pool.name
+        batcher.prefix_cache = IndexedPrefixCache(
+            batcher.prefix_cache, self.index, name
+        )
+        batcher.prefix_fetcher = self._make_fetcher(shard)
+        self._shards[name] = shard
+        import jax.numpy as jnp
+
+        # failover re-groups retire rounds on the SURVIVORS (the dead
+        # worker's requests re-admit wherever routing lands them), and
+        # the retire program jits per round width — pre-build every
+        # width now, while the shard is quiet, so no width is first
+        # seen inside a recovery wall. Releasing zero-length slots is
+        # the documented no-op and the result is discarded.
+        for width in range(1, int(batcher.slots) + 1):
+            batcher._release_many(
+                batcher.state, jnp.arange(width, dtype=jnp.int32)
+            )
+
+    # -- admission: the cross-shard fetch ---------------------------------
+
+    def _make_fetcher(self, shard):
+        def fetch(hashes, max_pages, free_fn):
+            try:
+                self._fetch(shard, hashes, max_pages, free_fn)
+            except Exception:  # noqa: BLE001 - degrade, never poison
+                # a fabric fetch must degrade to a cold prefill, never
+                # surface into the borrower's claim loop (a
+                # TransferFailed escaping here would mark the BORROWER
+                # down for the OWNER's link fault)
+                self.fetch_failures += 1
+
+        return fetch
+
+    def _fetch(self, shard, hashes, max_pages, free_fn) -> None:
+        batcher = shard.batcher
+        name = shard.pool.name
+        cache = batcher.prefix_cache
+        chain = hashes[:max_pages]
+        if not chain:
+            return
+        local = cache.lookup(chain, len(chain), record=False)
+        if len(local) >= len(chain):
+            return
+        self.cross_shard_lookups += 1
+        found = self.index.best_owner(chain, exclude=name, beyond=len(local))
+        if found is None:
+            return
+        owner_name, depth = found
+        owner = self._shards.get(owner_name)
+        if owner is None:
+            return
+        owner_cache = owner.batcher.prefix_cache
+        # re-resolve against the owner's LIVE cache — the directory is
+        # kept coherent, but the cache's own index is the page truth
+        owner_pages = owner_cache.lookup(chain, depth, record=False)
+        if len(owner_pages) <= len(local):
+            return
+        fetch_keys = chain[len(local):len(owner_pages)]
+        n = len(fetch_keys)
+        if n > max(0, int(free_fn())):
+            # no headroom for the fetched pages on top of the
+            # request's own worst case: cold prefill beats thrashing
+            return
+        # pin BEFORE moving: the owner's eviction must not reclaim the
+        # chain mid-move; the pin outlives the move (released at the
+        # borrower's finish_serve — the retire/drop/drain rule)
+        pin_keys = chain[: len(owner_pages)]
+        owner_cache.acquire(pin_keys)
+        pin = self.index.register_pin(owner_name, name, pin_keys)
+        src_ids = owner_pages[len(local):]
+        try:
+            dest = self._move_pages(owner, shard, src_ids, plane="fabric")
+        except Exception:
+            owner_cache.release(pin_keys)
+            self.index.release_pin(pin)
+            raise
+        # adopt into the borrower's cache (each imported page arrived
+        # with refcount 1 — the cache's ONE reference; a collision
+        # keeps the resident entry and unrefs the duplicate, the same
+        # rule insert/migration apply)
+        parent = chain[len(local) - 1] if local else None
+        adopted: list[bytes] = []
+        duplicates: list[int] = []
+        for key, page_id in zip(fetch_keys, dest):
+            if cache.adopt_entry(key, parent, page_id, live_users=0):
+                adopted.append(key)
+            else:
+                duplicates.append(page_id)
+            parent = key
+        if duplicates:
+            ids, alive = batcher._page_id_batch(duplicates)
+            batcher.state = batcher._cache_unref(batcher.state, ids, alive)
+        self.cross_shard_hits += 1
+        self.pages_fetched += n
+        hits = self.index.record_remote_hit(chain[len(owner_pages) - 1])
+        if hits < self.config.replicate_after:
+            # cold cross-shard traffic BORROWS (dropped after the
+            # serve); a chain hit this often REPLICATES — it stays
+            # cached here, so the hot prefix stops paying the wire
+            self._borrows.setdefault(name, []).append(adopted)
+        else:
+            self.replicas += 1
+
+    # -- the raw page hop --------------------------------------------------
+
+    #: moves pad their page list to the next multiple of this, so the
+    #: export/import programs are FIXED-SHAPE: one compile per (bucket,
+    #: pool dtype, device pair) instead of one per chain length. The
+    #: import masks rows past the real count (its standard static-width
+    #: chunk rule), so padding costs a few wire bytes, never a page.
+    MOVE_BUCKET = 8
+
+    def _move_pages(self, src, dst, page_ids, *, plane: str) -> list[int]:
+        """Move ``page_ids`` from ``src``'s pool into ``dst``'s pool
+        verbatim (pool representation — quantized layers move values +
+        scales raw) with refcount 1 installed per page (the receiving
+        cache's ONE reference). Returns the destination page ids.
+        ``plane`` ("fabric" | "mirror") names the op for per-plane
+        transfer accounting and the edge-paired flight events."""
+        import jax
+        import jax.numpy as jnp
+
+        from beholder_tpu.models.serving import (
+            paged_export_pages,
+            paged_import_pages,
+        )
+
+        src_name, dst_name = src.pool.name, dst.pool.name
+        n = len(page_ids)
+        fr = self.flight_recorder
+        ts = time.time() if fr is not None else 0.0
+        edge = fr.next_edge() if fr is not None else None
+        if edge is not None:
+            fr.instant(
+                f"{plane}.send", worker=src_name, dst=dst_name,
+                pages=n, edge=edge,
+            )
+        t0 = time.perf_counter()
+        padded = list(page_ids)
+        padded += [padded[-1]] * (-n % self.MOVE_BUCKET)
+        chunks_k, chunks_v = paged_export_pages(
+            src.batcher.state, jnp.asarray(padded, jnp.int32)
+        )
+        try:
+            dst_device = next(iter(dst.batcher.state.seq_lens.devices()))
+        except Exception:  # noqa: BLE001 - uncommitted single-device state
+            dst_device = None
+        chunks_k, chunks_v = self.transfer.raw_move(
+            (chunks_k, chunks_v), dst_device,
+            src=src_name, dst=dst_name,
+            op=f"{plane}.{src_name}->{dst_name}",
+        )
+        new_state, dest = paged_import_pages(
+            dst.batcher.state, chunks_k, chunks_v,
+            jnp.int32(n), jnp.ones(len(padded), jnp.int32),
+        )
+        dst.batcher.state = new_state
+        dest = np.asarray(jax.device_get(dest))[:n]
+        if fr is not None:
+            edge_note = {"edge": edge} if edge is not None else {}
+            fr.record(
+                plane, ts, time.perf_counter() - t0,
+                worker=dst_name, src=src_name, pages=n, **edge_note,
+            )
+        return [int(d) for d in dest]
+
+    # -- pin lifecycle -----------------------------------------------------
+
+    def _release_borrower_pins(self, name: str) -> None:
+        for pin in self.index.take_pins(borrower=name):
+            owner = self._shards.get(pin["owner"])
+            if owner is not None:
+                owner.batcher.prefix_cache.release(pin["keys"])
+            self.pins_released += 1
+
+    def finish_serve(self, shard) -> None:
+        """The borrower's serve retired its slots: release its
+        cross-shard pins against their owners and drop transient
+        borrows (their device reference comes off in one vectorized
+        unref; a borrowed page a live slot still shares survives at
+        refcount >= 1 — ``drop_entries``'s own safety rule)."""
+        name = shard.pool.name
+        self._release_borrower_pins(name)
+        chains = self._borrows.pop(name, None)
+        if not chains:
+            return
+        batcher = shard.batcher
+        dropped: list[int] = []
+        for keys in chains:
+            dropped.extend(batcher.prefix_cache.drop_entries(keys))
+        if dropped:
+            ids, alive = batcher._page_id_batch(dropped)
+            batcher.state = batcher._cache_unref(batcher.state, ids, alive)
+            self.borrows_dropped += len(dropped)
+
+    # -- failure / drain ----------------------------------------------------
+
+    def on_worker_down(self, scheduler, name: str):
+        """A worker failed: its borrower pins release against the
+        surviving owners, pins against its own (dead) pool just leave
+        the ledger, the directory forgets it — and, when a standby is
+        mirroring, the standby is promoted so recovery re-admits onto
+        warm pages instead of replaying prefill."""
+        self._release_borrower_pins(name)
+        # the dead worker's pool died with its pins — nothing to
+        # release on a device that no longer serves
+        self.pins_released += len(self.index.take_pins(owner=name))
+        self._borrows.pop(name, None)
+        self.index.forget_shard(name)
+        self._shards.pop(name, None)
+        if self.standby is not None and name == self.standby.pool.name:
+            # defensive: the standby itself died — discard, re-spawn
+            # at the next sync
+            self.standby = None
+            self.standby_failures += 1
+            return None
+        if self.standby is not None:
+            return self.promote(scheduler)
+        return None
+
+    def promote(self, scheduler):
+        """Failover's page-table swap: the mirrored standby joins the
+        routing set as a full shard. Recovery then re-admits the dead
+        worker's requests against a pool already holding their warm
+        prefix pages — admission is a prefix HIT plus pin adoption,
+        not a re-prefill; that is the near-zero-recovery claim the
+        bench measures."""
+        shard = self.standby
+        self.standby = None
+        if shard is None:  # pragma: no cover - guarded by callers
+            return None
+        shard.pool.shard_id = len(scheduler.shards)
+        scheduler.shards.append(shard)
+        scheduler.pool_view.shards.append(shard.pool)
+        if scheduler.failover is not None:
+            scheduler.failover.adopt_worker(shard.pool.name)
+        if scheduler.instruments is not None:
+            scheduler.instruments.shards.set(
+                sum(
+                    1 for s in scheduler.shards
+                    if scheduler.failover is None
+                    or scheduler.failover.state(s.pool.name)
+                    not in ("down", "drained")
+                )
+            )
+        scheduler.pool_view.refresh_gauges(scheduler.instruments)
+        self.promotions += 1
+        if self.flight_recorder is not None:
+            self.flight_recorder.instant(
+                "promote", worker=shard.pool.name,
+                pages=int(shard.batcher.prefix_cache.page_count),
+            )
+        # wrapping the (plain, dark) mirror cache publishes every
+        # mirrored chain — the promoted shard becomes a fetch owner
+        self.attach_shard(shard)
+        return shard
+
+    def on_drain(self, name: str, target: str) -> None:
+        """A planned drain migrated ``name``'s pool to ``target``:
+        outstanding pins against the drained owner repoint there (the
+        chains and their ``live_users`` marks moved byte-identically),
+        its own borrows release, and the directory forgets it — the
+        migration itself re-published the chains under ``target``
+        through its wrapped cache's ``adopt_entry``."""
+        self._release_borrower_pins(name)
+        self.index.rewrite_pin_owner(name, target)
+        self._borrows.pop(name, None)
+        self.index.forget_shard(name)
+        self._shards.pop(name, None)
+
+    # -- the standby mirror --------------------------------------------------
+
+    def sync(self, scheduler) -> None:
+        """Between-serves housekeeping: with ``standby`` configured,
+        spawn the dark standby on first use and refresh its mirror
+        from every attached primary. A standby that dies mid-mirror
+        (chaos: a scripted transfer fault on its link) is DISCARDED —
+        the primaries were only ever read, so they keep serving — and
+        a fresh standby re-syncs from live pages at the next call."""
+        if not self.config.standby:
+            return
+        from beholder_tpu.cluster.failover import WorkerKilled
+        from beholder_tpu.cluster.transfer import TransferFailed
+
+        try:
+            if self.standby is None:
+                self._spawn_standby(scheduler)
+            self.mirror.sync(self.standby, self._mirror_sources(scheduler))
+        except (TransferFailed, WorkerKilled):
+            self.standby = None
+            self.standby_failures += 1
+
+    def _mirror_sources(self, scheduler) -> list:
+        up = self._shards
+        if scheduler.failover is not None:
+            from beholder_tpu.cluster.failover import WORKER_UP
+
+            state = scheduler.failover.state
+            return [
+                up[n] for n in sorted(up)
+                if state(up[n].pool.name) == WORKER_UP
+            ]
+        return [up[n] for n in sorted(up)]
+
+    def _spawn_standby(self, scheduler) -> None:
+        from beholder_tpu.parallel.mesh import serving_shard_devices
+
+        device = serving_shard_devices(scheduler._devices_used + 1)[-1]
+        scheduler._devices_used += 1
+        n = self.standbys_spawned
+        self.standbys_spawned += 1
+        # id space disjoint from decode-<n> until promotion re-ids it;
+        # the name marks its provenance in health/trace output
+        shard = scheduler._build_shard(
+            1000 + n, device, name=f"standby-{n}"
+        )
+        self._warm_standby(shard)
+        self._probe_links(shard)
+        self.standby = shard
+        if self.flight_recorder is not None:
+            self.flight_recorder.instant(
+                "standby", worker=shard.pool.name, action="spawn"
+            )
+
+    #: shape-replay budget for :meth:`_warm_standby` — real serving
+    #: workloads bucket into a handful of geometries; past this, warming
+    #: the tail costs more housekeeping time than the promotion saves
+    MAX_WARM_SHAPES = 8
+
+    def _warm_standby(self, shard) -> None:
+        """Compile the dark standby's serving programs at spawn time.
+
+        Promotion must be near-zero: the recovery pass after a worker
+        death re-admits the dead worker's requests onto the standby's
+        mirrored pages, and on a freshly-built batcher that first serve
+        would pay every XLA compile (admission prefill, warm-hit
+        adoption, tick chunk/carry, release, readback) INSIDE the
+        recovery wall — tens of compile-seconds against a
+        page-adoption path that is otherwise milliseconds. Programs jit
+        per request geometry, so a generic warmup misses the shapes
+        that matter; instead the standby replays the PRIMARIES'
+        observed serve shapes (each batcher's ``seen_request_shapes``
+        working set, at its observed concurrency) — the standard
+        compile-ahead-with-representative-shapes serving warmup. Each
+        shape runs twice — cold, then again as a warm prefix hit — so
+        both admission paths' executables plus the tick/retire
+        programs exist for the standby's device before it is ever
+        promoted. The throwaway chains are then dropped and their
+        device references unref'd: the mirror still starts from a
+        pristine cache on a pristine pool, and the whole cost lands in
+        between-serves housekeeping while the primaries keep serving."""
+        from beholder_tpu.models.serving import Request
+
+        batcher = shard.batcher
+        shapes: dict[tuple[int, int], int] = {}
+        for primary in self._shards.values():
+            for key, n in primary.batcher.seen_request_shapes.items():
+                shapes[key] = max(shapes.get(key, 0), n)
+        if not shapes:
+            # nothing observed yet: a minimal request still builds the
+            # shape-independent programs (release/unref/readback)
+            shapes = {(int(batcher.page_size) + 1, 2): 1}
+        replay = sorted(shapes.items())[-self.MAX_WARM_SHAPES:]
+        cache = batcher.prefix_cache
+        for (width, horizon), n in replay:
+            reqs = [
+                Request(
+                    np.cumsum(np.full(width, 1.0 + 0.25 * i)),
+                    np.full(width, 2),
+                    horizon,
+                )
+                for i in range(n)
+            ]
+            batcher.run(reqs)  # cold: batched prefill + tick + retire
+            if cache is not None:
+                batcher.run(reqs)  # warm: the prefix-hit admission twin
+        import jax.numpy as jnp
+
+        # the retire program jits per round width, and recovery retire
+        # rounds group however the re-routed requests happen to land —
+        # releasing zero-length slots is the documented no-op, so every
+        # width is one discarded call on the pristine state
+        for width in range(1, int(batcher.slots) + 1):
+            batcher._release_many(
+                batcher.state, jnp.arange(width, dtype=jnp.int32)
+            )
+        if cache is None:  # pragma: no cover - fabric implies caches
+            return
+        keys = [key for key, _, _, _ in cache.export_entries()]
+        dropped = cache.drop_entries(keys)
+        if dropped:
+            ids, alive = batcher._page_id_batch(dropped)
+            batcher.state = batcher._cache_unref(batcher.state, ids, alive)
+
+    def _probe_links(self, standby) -> None:
+        """Pre-compile the promoted-standby FETCH programs: one
+        bucket-width probe move standby -> each primary builds the
+        export-on-standby / import-on-primary executables. The mirror's
+        own syncs compile only the opposite direction (primary export,
+        standby import), so without the probe a survivor's first
+        cross-shard fetch after promotion — the page pull that replaces
+        its re-prefill — would pay those compiles inside the recovery
+        wall. The probe page is unref'd on arrival (refcount 1 -> 0,
+        back on the free stack), so every pool stays pristine; a link
+        fault here propagates to :meth:`sync`'s discard-and-respawn
+        handling like any other standby housekeeping failure."""
+        for name in sorted(self._shards):
+            primary = self._shards[name]
+            dest = self._move_pages(standby, primary, [0], plane="mirror")
+            batcher = primary.batcher
+            ids, alive = batcher._page_id_batch(dest)
+            batcher.state = batcher._cache_unref(batcher.state, ids, alive)

@@ -1,0 +1,99 @@
+"""Standby-replica page mirroring.
+
+The standby shard's own radix cache IS the mirror state: each
+:meth:`StandbyMirror.sync` diffs every primary's prefix-cache index
+against what the standby already caches, moves only the FRESH pages
+(pool representation, verbatim — the same
+:func:`~beholder_tpu.models.serving.paged_export_pages` /
+:func:`~beholder_tpu.models.serving.paged_import_pages` pair every
+other fabric hop rides), and drops entries no primary caches anymore
+(staleness — a mirror must track evictions or it slowly becomes a
+museum of dead prefixes holding real pages hostage).
+
+The standby stays DARK: it owns no slots, serves no requests, holds
+every mirrored page at the cache's refcount 1 with ``live_users=0``,
+and its cache is a plain :class:`~beholder_tpu.cache.prefix.
+PrefixCache` (never published into the global directory) so it can
+never be picked as a fetch owner or a mirror source. Promotion
+(:meth:`~.engine.FabricEngine.promote`) is what turns the mirror into
+serving state: the recovered requests re-admit against the warm cache
+— a page-table row written from already-resident pages plus pin
+adoption, not a re-prefill.
+
+Mirroring runs BETWEEN serves (the router's sync point), where the
+primaries' pools are settled — live-slot transients never mirror,
+which is exactly right: a mid-serve slot's pages are re-derivable
+from the request (the splice ledger guarantees no token is lost), but
+the prefix cache is the expensive-to-rebuild state.
+"""
+
+from __future__ import annotations
+
+
+class StandbyMirror:
+    """Asynchronous page mirroring onto the dark standby shard."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.mirrored_pages = 0
+        self.stale_dropped = 0
+        #: pages a sync could not place for standby headroom (counted,
+        #: never silently capped)
+        self.skipped_pages = 0
+        self.syncs = 0
+
+    def sync(self, standby, primaries: list) -> None:
+        """One mirror pass: per primary, move pages the standby does
+        not cache yet (parent-first — any prefix of an export is
+        parent-closed, so a headroom cut still adopts valid chains),
+        then drop standby entries no primary indexes anymore."""
+        import jax
+
+        cache = standby.batcher.prefix_cache
+        if cache is None:  # pragma: no cover - factory-less cluster
+            return
+        batcher = standby.batcher
+        union: set[bytes] = set()
+        for shard in primaries:
+            src_cache = shard.batcher.prefix_cache
+            if src_cache is None:
+                continue
+            entries = src_cache.export_entries()
+            union.update(key for key, _, _, _ in entries)
+            fresh = [
+                (key, parent, page_id)
+                for key, parent, page_id, _ in entries
+                if key not in cache._entries
+            ]
+            if not fresh:
+                continue
+            free = int(jax.device_get(batcher.state.free_top))
+            if len(fresh) > free:
+                self.skipped_pages += len(fresh) - free
+                fresh = fresh[:free]
+            if not fresh:
+                continue
+            dest = self.engine._move_pages(
+                shard, standby, [pid for _, _, pid in fresh],
+                plane="mirror",
+            )
+            duplicates: list[int] = []
+            for (key, parent, _), new_id in zip(fresh, dest):
+                if not cache.adopt_entry(key, parent, new_id, live_users=0):
+                    duplicates.append(new_id)
+            if duplicates:  # pragma: no cover - keys were diffed above
+                ids, alive = batcher._page_id_batch(duplicates)
+                batcher.state = batcher._cache_unref(
+                    batcher.state, ids, alive
+                )
+            self.mirrored_pages += len(fresh)
+        stale = [key for key in list(cache._entries) if key not in union]
+        if stale:
+            dropped = cache.drop_entries(stale)
+            if dropped:
+                ids, alive = batcher._page_id_batch(dropped)
+                batcher.state = batcher._cache_unref(
+                    batcher.state, ids, alive
+                )
+                self.stale_dropped += len(dropped)
+        self.syncs += 1

@@ -35,6 +35,11 @@ Scheduling structure:
   longer fit their shard migrate to the least-pressure shard
   (``reason="rebalance"``), so one hot shard cannot starve while
   another idles.
+- **Cluster memory fabric** (:mod:`.fabric`, optional): admission on
+  any shard consults the global prefix index and pulls remotely warm
+  chains over the transfer engine; between serves the engine keeps a
+  dark standby shard mirrored so a worker death promotes it instead
+  of replaying prefill.
 
 Instrumentation is host-side only (zero device reads, the serving
 discipline): cluster series register only when a registry is wired,
@@ -172,6 +177,20 @@ class ClusterScheduler:
             if cluster.failover is not None
             else None
         )
+        #: cluster memory fabric (None — the default — keeps every
+        #: shard's prefix cache private and failover on the replay
+        #: path, byte-identically): the global prefix index + the
+        #: standby-replica mirror, sharing the transfer engine
+        self.fabric = None
+        if cluster.fabric is not None:
+            from .fabric.engine import FabricEngine
+
+            self.fabric = FabricEngine(
+                cluster.fabric, self.transfer,
+                flight_recorder=flight_recorder,
+            )
+            for shard in self.shards:
+                self.fabric.attach_shard(shard)
         #: admission-order results decided outside a serve (drain-time
         #: shard_down drops), merged by run_pending
         self._pending_drops: dict[int, object] = {}
@@ -187,11 +206,15 @@ class ClusterScheduler:
 
     # -- shard construction / scaling ------------------------------------
 
-    def _build_shard(self, shard_id: int, device) -> _Shard:
+    def _build_shard(
+        self, shard_id: int, device, name: str | None = None
+    ) -> _Shard:
         """One decode shard exactly as ``__init__`` builds them — also
         the autoscaler's :meth:`scale_up` path, so a spawned shard is
         indistinguishable from a boot-time one (same batcher knobs,
-        same placement, same intake policy)."""
+        same placement, same intake policy). ``name`` overrides the
+        ``decode-<id>`` pool name (the fabric's dark standby lives
+        outside the decode id space until promotion)."""
         from beholder_tpu.models.serving import ContinuousBatcher
         from beholder_tpu.reliability.shed import IntakeQueue
 
@@ -215,6 +238,8 @@ class ClusterScheduler:
         batcher.state = place_paged_state(batcher.state, device)
         batcher.params = place_paged_state(batcher.params, device)
         pool = ShardPool(shard_id, batcher.num_pages, device=device)
+        if name is not None:
+            pool.name = name
         # the router owns the shard intakes: queued items are
         # (submit sequence, request) pairs so run_pending() can
         # hand results back in ADMISSION order across the whole
@@ -298,6 +323,8 @@ class ClusterScheduler:
             from .failover import WORKER_UP
 
             self.failover._set_state(shard.pool.name, WORKER_UP)
+        if self.fabric is not None:
+            self.fabric.attach_shard(shard)
         if self.instruments is not None:
             self.instruments.shards.set(
                 sum(
@@ -366,7 +393,15 @@ class ClusterScheduler:
                 "drain requires instance.cluster.failover — the "
                 "fail-stop cluster has no migration machinery"
             )
-        return self.failover.drain(shard_id)
+        name = self.shards[shard_id].pool.name
+        result = self.failover.drain(shard_id)
+        if self.fabric is not None:
+            # cross-shard pins against the drained pool repoint to the
+            # migration target (the chains and their live_users marks
+            # moved byte-identically); the drained shard leaves the
+            # directory
+            self.fabric.on_drain(name, result["target"])
+        return result
 
     def shutdown(self, drain: bool = True) -> None:
         """Planned full-cluster shutdown (the SIGTERM path when
@@ -609,7 +644,10 @@ class ClusterScheduler:
             pending = []
             self.pool_view.refresh_gauges(self.instruments)
             for shard in self.shards:
-                items = assignments[shard.pool.shard_id]
+                # .get, not []: a standby promoted mid-pass (fabric
+                # failover) appends to self.shards DURING this loop —
+                # it has no assignment yet and serves next pass
+                items = assignments.get(shard.pool.shard_id)
                 if not items:
                     continue
                 if fo is not None:
@@ -645,6 +683,12 @@ class ClusterScheduler:
                     for _, _, need in items:
                         shard.pool.release(need)
                     kind = fo.on_shard_failure(shard, err)
+                    if self.fabric is not None:
+                        # release the dead worker's cross-shard pins,
+                        # drop its directory facts, and — when a
+                        # standby is mirroring — promote it in place
+                        # of the replay path
+                        self.fabric.on_worker_down(self, shard.pool.name)
                     retried = 0
                     for key, req, _ in items:
                         attempts[key] = attempts.get(key, 0) + 1
@@ -679,6 +723,12 @@ class ClusterScheduler:
                 # splice refusal must not strand committed pages)
                 for _, _, need in items:
                     shard.pool.release(need)
+                if self.fabric is not None:
+                    # the serve retired its slots: release this
+                    # borrower's cross-shard pins, drop transient
+                    # borrows that never reached the replication
+                    # threshold
+                    self.fabric.finish_serve(shard)
                 for (key, _, _), res in zip(items, served):
                     if fo is not None and isinstance(res, np.ndarray):
                         res = fo.splice(key, res)
@@ -695,6 +745,10 @@ class ClusterScheduler:
             # entries for terminal outcomes (splice already consumed
             # the rest) must not survive into the next call
             fo.discard_emitted(list(out))
+        if self.fabric is not None:
+            # fabric housekeeping between serves: spawn the standby on
+            # first use and keep its mirror fresh against settled pools
+            self.fabric.sync(self)
         self.pool_view.refresh_gauges(self.instruments)
         return out
 
@@ -782,6 +836,8 @@ class ClusterScheduler:
             served = self._serve(shard, requests)
             for req in requests:
                 shard.pool.release(self._need(req))
+            if self.fabric is not None:
+                self.fabric.finish_serve(shard)
             collected.extend(
                 zip((seq for seq, _ in pending), served)
             )
@@ -789,6 +845,8 @@ class ClusterScheduler:
                 self.instruments.requests_total.inc(
                     len(pending), shard=str(shard.pool.shard_id)
                 )
+        if self.fabric is not None:
+            self.fabric.sync(self)
         self.pool_view.refresh_gauges(self.instruments)
         collected.extend(drops.items())
         collected.sort(key=lambda pair: pair[0])

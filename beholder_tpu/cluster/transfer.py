@@ -130,6 +130,11 @@ class PageTransferEngine:
         self.bytes = 0
         #: terminal transfer failures (retries exhausted)
         self.failed = 0
+        #: successful hops by plane (the ``op`` prefix before the first
+        #: ``.`` — "transfer", "drain", "fabric", "mirror"), so the
+        #: bench and tests can attribute wire traffic to the subsystem
+        #: that moved it without parsing the flight ring
+        self.ops_by_plane: dict[str, int] = {}
         #: chaos injections observed
         self.faults_injected = 0
         self._fail_next = 0
@@ -177,13 +182,17 @@ class PageTransferEngine:
         fallback (no hop, but the chaos/fault surface still applies so
         tests behave identically on one device). Terminal failure
         raises :class:`TransferFailed` and counts it."""
+        plane = op.split(".", 1)[0]
         try:
             if self.retry is not None:
-                return self.retry.call(
+                out = self.retry.call(
                     lambda: self._device_put(tree, device, dst=dst),
                     op=op,
                 )
-            return self._device_put(tree, device, dst=dst)
+            else:
+                out = self._device_put(tree, device, dst=dst)
+            self.ops_by_plane[plane] = self.ops_by_plane.get(plane, 0) + 1
+            return out
         except Exception as err:  # noqa: BLE001 - typed terminal surface
             self.failed += 1
             if self.instruments is not None:

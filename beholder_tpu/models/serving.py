@@ -1697,6 +1697,19 @@ class ContinuousBatcher:
         #: hash chain (full prefix pages) each live slot holds in the
         #: prefix cache; released at retirement
         self._slot_chain: list[list[bytes]] = [[] for _ in range(slots)]
+        #: optional cluster-fabric admission hook
+        #: (``fabric(hashes, max_pages, free_pages)``): invoked right
+        #: before the prefix-cache lookup so a chain warm on another
+        #: shard can be pulled into THIS pool and the ordinary local
+        #: lookup below hits it. None (the default) leaves admission
+        #: byte-identical to a fabric-less batcher.
+        self.prefix_fetcher = None
+        #: request geometries :meth:`run` has served — ``(T+1, horizon)
+        #: -> max concurrent count`` (capped at ``slots``). Programs jit
+        #: per shape, so this map IS the executable working set; the
+        #: cluster fabric replays it through a dark standby at spawn so
+        #: promotion re-admits onto already-compiled programs.
+        self.seen_request_shapes: dict[tuple[int, int], int] = {}
         if prefix_cache is not None:
             self._cache_ref = jax.jit(cache_ref_pages)
             self._cache_unref = jax.jit(cache_unref_pages)
@@ -1918,6 +1931,12 @@ class ContinuousBatcher:
                 pinned: list[bytes] = []
                 if self.prefix_cache is not None:
                     hashes = self.prefix_cache.hashes(feats_np)
+                    if self.prefix_fetcher is not None and hashes:
+                        # cluster fabric: pull a remotely warm chain
+                        # into this pool so the local lookup below hits
+                        self.prefix_fetcher(
+                            hashes, (t - 1) // self.page_size, free_pages
+                        )
                     hit_pages = self.prefix_cache.lookup(
                         hashes, (t - 1) // self.page_size, record=False
                     )
@@ -2245,6 +2264,14 @@ class ContinuousBatcher:
         by side in ``bench.py`` (``serving.run_value`` vs
         ``serving.value``)."""
         self._start_run(requests)
+        counts: dict[tuple[int, int], int] = {}
+        for r in requests:
+            key = (len(r.progress), r.horizon)
+            counts[key] = counts.get(key, 0) + 1
+        for key, n in counts.items():
+            self.seen_request_shapes[key] = max(
+                self.seen_request_shapes.get(key, 0), min(n, self.slots)
+            )
         t0 = time.perf_counter()
         try:
             with self._run_span(

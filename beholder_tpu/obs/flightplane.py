@@ -14,7 +14,8 @@ is the cross-worker layer:
   share) the monotonic axis the anchor ties them to.
 - **Edge ids.** Binding arms :meth:`FlightRecorder.next_edge`; the
   cluster layer's send/recv instrumentation (transfer/handoff, drain
-  restock) then tags each cross-worker hop with one shared edge id —
+  restock, and the memory fabric's ``fabric``/``mirror`` page hops)
+  then tags each cross-worker hop with one shared edge id —
   a ``<base>.send`` instant in the sending ring paired with the
   receiving ring's event. Matched pairs both refine skew alignment
   (a receive can never precede its send) and render as Perfetto flow

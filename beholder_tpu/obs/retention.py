@@ -43,6 +43,16 @@ the incident id and the incident record carries the sentinel's ranked
 explanation — "readback on decode-1 regressed, here are 12 full traces
 from the window" comes from the daemon itself.
 
+**Cross-worker federation**: when a cluster flight plane is linked
+(:meth:`TraceVault.link_flight_plane`), incident-kept traces are
+assembled from the MERGED plane timeline
+(:func:`~beholder_tpu.obs.flightplane.merge` — every worker's ring on
+the cluster clock, skew-aligned) instead of the local buffer, so one
+``GET /debug/traces/<id>`` shows the request's whole cross-worker
+story; such traces carry ``federated: true``. Federation is
+best-effort: any merge problem falls back to the local assembly,
+never into the serving path.
+
 Default OFF behind ``instance.observability.retention.*``
 (:func:`beholder_tpu.obs.retention_from_config`): off, serving output
 and the /metrics exposition stay byte-identical and the debug routes
@@ -196,6 +206,10 @@ class TraceVault:
         #: incident state: the ACTIVE incident dict (or None) plus a
         #: bounded history of closed ones
         self.incident: dict[str, Any] | None = None
+        #: cluster flight plane (see :meth:`link_flight_plane`) —
+        #: None keeps every assembly local, byte-identically
+        self._flight_plane = None
+        self.federated = 0
         self.incidents_opened = 0
         self._incident_seq = 0
         self._incident_history: deque[dict[str, Any]] = deque(maxlen=8)
@@ -236,6 +250,16 @@ class TraceVault:
                     "and fast-burn breaches)",
                 ),
             }
+
+    def link_flight_plane(self, flight_plane) -> None:
+        """Arm cross-worker federation: incident-kept traces assemble
+        from the MERGED cluster flight plane
+        (:func:`~beholder_tpu.obs.flightplane.merge`) instead of the
+        local buffer, so the vault's evidence spans every worker a
+        recovered request touched. No-op retention change outside an
+        incident — the ordinary keep path stays byte-identical."""
+        with self._lock:
+            self._flight_plane = flight_plane
 
     # -- the streaming fold (flight-recorder listener) -------------------
 
@@ -374,10 +398,42 @@ class TraceVault:
             q = min(tracked, key=lambda t: abs(t - q))
         return ttft_s >= ttft.quantile(q)
 
+    def _federate(self, key, trace_ids) -> list | None:
+        """Assemble this request's events out of the MERGED cluster
+        flight plane: every worker's plane ring skew-aligned onto the
+        cluster clock, then the same trace/key selection the local
+        buffer uses. Returns None (caller falls back to the local
+        assembly) when the plane holds fewer than two rings or
+        anything about the merge fails — federation must never raise
+        into the serving path."""
+        try:
+            from .flightplane import merge
+
+            rings = self._flight_plane.rings()
+            if len(rings) < 2:
+                return None
+            merged = merge(rings)
+            out = [
+                e for e in merged.events
+                if e.get("trace_id") in trace_ids or _key_of(e) == key
+            ]
+            if not out:
+                return None
+            cap = self.config.max_events_per_trace
+            return out[-cap:] if len(out) > cap else out
+        except Exception:  # pragma: no cover - defensive
+            return None
+
     def _keep(
         self, key, trace_ids, events, timeline, outcome, reasons
     ) -> None:
         self._id_seq += 1
+        federated = None
+        if self._flight_plane is not None and "incident" in reasons:
+            federated = self._federate(key, trace_ids)
+            if federated is not None:
+                events = federated
+                self.federated += 1
         primary_trace = next(
             (t for t in sorted(trace_ids, key=str) if t), None
         )
@@ -396,6 +452,8 @@ class TraceVault:
             "events": len(events),
             "bytes": len(payload),
         }
+        if federated is not None:
+            summary["federated"] = True
         if timeline is not None:
             summary["timeline"] = timeline.to_dict()
         if self.incident is not None and "incident" in reasons:
@@ -577,6 +635,10 @@ class TraceVault:
 
             doc = chrome_trace(entry["events"])
             doc["vault"] = entry["summary"]
+            if entry["summary"].get("federated"):
+                # the events came from the merged cluster flight
+                # plane, not this worker's local buffer
+                doc["federated"] = True
             return 200, "application/json", json.dumps(doc).encode()
 
         trace_detail_route.wants_path = True

@@ -350,6 +350,11 @@ class BeholderService:
             from beholder_tpu.metrics import set_exemplar_resolver
 
             set_exemplar_resolver(self.trace_vault.trace_ref)
+            if self.flight_plane is not None:
+                # incident-kept traces federate: assembled from the
+                # MERGED cluster flight plane (every worker's ring,
+                # skew-aligned) and stamped ``federated: true``
+                self.trace_vault.link_flight_plane(self.flight_plane)
         self.sentinel = sentinel_from_config(
             config,
             slo=self.slo,

@@ -52,6 +52,14 @@ ratio``                     fp8 admitted / int8 admitted on pools     lower
 ``fused_wave_ratio``        fused-wave / dense-wave run_waves wall,   higher
                             interleaved in the same session after a
                             bitwise stream assert — host divides out
+``fabric_cross_shard_hit_
+ratio``                     cross-shard prefix-index hits / lookups   lower
+                            on a workload warm ONLY on another shard
+                            — pure admission accounting
+``replica_recovery_ratio``  replayed-recovery wall / standby-         lower
+                            promotion recovery wall, both measured
+                            interleaved in the same session after
+                            bitwise stream asserts
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -176,6 +184,23 @@ NOISE_BANDS: dict[str, float] = {
     # Same interleaved-ratio width as fused_verify_ratio; what it must
     # catch is the fused wave lane losing its edge, not jitter
     "fused_wave_ratio": 0.40,
+    # cross-shard hits / lookups on the fabric bench's workload, whose
+    # prefixes are warm ONLY on another shard (schema v15): pure
+    # admission accounting — no walls, host-independent, and
+    # near-deterministic (directory contents + the replayed request
+    # mix). The band only absorbs request-mix tweaks between rounds;
+    # degradation = the ratio FALLING (warm-anywhere admission
+    # silently turning back into cold prefill)
+    "fabric_cross_shard_hit_ratio": 0.30,
+    # replayed-recovery wall / standby-promotion recovery wall, both
+    # killed-shard passes measured interleaved in the same session
+    # after bitwise stream asserts (schema v15) — host drift divides
+    # out. Recovery walls on a small bench are tail-noisy (one
+    # straggler pass moves the mean; observed run-to-run swing spans
+    # ~0.4-0.7 on the CPU tunnel), hence the widest band here;
+    # degradation = the ratio FALLING (the standby no longer buying
+    # recovery time over replay)
+    "replica_recovery_ratio": 0.60,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -315,6 +340,20 @@ def _fused_wave_ratio(artifact: dict) -> float | None:
     return float(value)
 
 
+def _fabric_hit_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "fabric", "cross_shard_prefix_hit_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v15 artifact / fabric scenario not run
+    return float(value)
+
+
+def _replica_recovery_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "fabric", "replica_recovery_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v15 artifact / fabric scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -355,6 +394,12 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # fused-wave/dense-wave serving wall: the fused lane losing its
     # edge shows as the ratio RISING back toward the dense program
     ("fused_wave_ratio", _fused_wave_ratio, "higher"),
+    # cross-shard hits/lookups on the warm-on-another-shard workload:
+    # the warm-anywhere admission eroding shows as the ratio FALLING
+    ("fabric_cross_shard_hit_ratio", _fabric_hit_ratio, "lower"),
+    # replayed/standby-promotion recovery wall: the standby losing its
+    # edge over replay shows as the ratio FALLING toward 1.0
+    ("replica_recovery_ratio", _replica_recovery_ratio, "lower"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -456,6 +501,24 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "capacity_admitted_bf16",
         lambda a: _get(a, "capacity", "admitted_bf16"),
+    ),
+    # fabric evidence behind the v15 ratios: page counts and absolute
+    # recovery milliseconds are workload/host-dependent, reported only
+    (
+        "fabric_pages_fetched",
+        lambda a: _get(a, "fabric", "pages_fetched"),
+    ),
+    (
+        "fabric_mirrored_pages",
+        lambda a: _get(a, "fabric", "mirrored_pages"),
+    ),
+    (
+        "fabric_replayed_recovery_ms",
+        lambda a: _get(a, "fabric", "replayed_recovery_ms"),
+    ),
+    (
+        "fabric_replica_recovery_ms",
+        lambda a: _get(a, "fabric", "replica_recovery_ms"),
     ),
 ]
 

@@ -53,12 +53,14 @@ def load_events(path: str) -> list[dict[str, Any]]:
 WORKER_TID_BASE = 100_000
 
 #: failover-subsystem events: worker failures, drain migrations,
-#: missed heartbeats and deadline retirements render in their own
+#: missed heartbeats, deadline retirements, and the fabric's standby
+#: lifecycle (``standby`` spawn / ``promote``) render in their own
 #: category (Perfetto can filter/color them apart from serving
 #: phases), as instants — or, for ``drain``, a duration slice — on
 #: the OWNING worker's track (they all carry a ``worker`` arg)
 FAILOVER_EVENTS = frozenset(
-    {"failover", "drain", "heartbeat", "deadline_exceeded"}
+    {"failover", "drain", "heartbeat", "deadline_exceeded",
+     "promote", "standby"}
 )
 
 

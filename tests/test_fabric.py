@@ -1,0 +1,433 @@
+"""Cluster memory fabric (ISSUE 18): the global prefix index
+(warm-anywhere admission via byte-identical cross-shard page fetch,
+borrow-vs-replicate, cross-shard pin release) and standby-replica
+recovery (dark standby mirroring, promotion instead of re-prefill
+replay), plus the default-OFF byte-identical pin, chaos on the mirror
+link, drain/failover pin hygiene, the config parse, and the
+flight-plane-federated incident traces served at /debug/traces/<id>."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.cache import PrefixCache
+from beholder_tpu.cluster import (
+    ClusterConfig,
+    FabricConfig,
+    FailoverConfig,
+    cluster_from_config,
+)
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.metrics import Metrics
+from beholder_tpu.reliability.chaos import (
+    WorkerFault,
+    inject_worker_fault,
+)
+
+pytestmark = [pytest.mark.fabric, pytest.mark.cluster, pytest.mark.chaos]
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+def _request(seed, t=16, horizon=6):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+    )
+
+
+BATCHER_KW = dict(
+    num_pages=32, page_size=8, slots=2, max_prefix=16, max_pages_per_seq=4
+)
+
+
+def _mk_cluster(model, state, cfg, **kwargs):
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    kw.setdefault("prefix_cache_factory", lambda: PrefixCache(8))
+    return ClusterScheduler(model, state.params, cfg, **kw)
+
+
+def _fabric_cfg(fabric=None, failover=False, **kwargs):
+    kw = dict(n_decode_workers=2, route_policy="round_robin", fabric=fabric)
+    if failover:
+        kw["failover"] = FailoverConfig()
+    kw.update(kwargs)
+    return ClusterConfig(**kw)
+
+
+def _assert_pool_pristine(batcher):
+    st = jax.device_get(batcher.state)
+    assert int(st.free_top) == batcher.num_pages
+    assert int(np.asarray(st.page_ref).sum()) == 0
+
+
+def _assert_cluster_pristine(cluster):
+    for shard in cluster.shards:
+        _assert_pool_pristine(shard.batcher)
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_fabric_config_parse_and_validation():
+    cfg = cluster_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "fabric": {
+                            "enabled": True,
+                            "replicate_after": 3,
+                            "standby": True,
+                        },
+                    }
+                }
+            }
+        )
+    )
+    assert cfg.fabric is not None
+    assert cfg.fabric.replicate_after == 3
+    assert cfg.fabric.standby is True
+    # fabric disabled (or absent) -> None: the fabric-less cluster
+    off = cluster_from_config(
+        ConfigNode({"instance": {"cluster": {"enabled": True}}})
+    )
+    assert off.fabric is None
+    explicit_off = cluster_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "fabric": {"enabled": False, "standby": True},
+                    }
+                }
+            }
+        )
+    )
+    assert explicit_off.fabric is None
+    with pytest.raises(ValueError):
+        FabricConfig(replicate_after=0)
+
+
+# -- warm-anywhere admission -------------------------------------------------
+
+
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8", "fp8"])
+def test_cross_shard_prefix_hit_stream_bitwise(model_state, cache_dtype):
+    """The acceptance pin: a request admitted on shard B against a
+    prefix warm only on shard A must stream bitwise-identically to the
+    LOCAL warm hit of the same request — the cross-shard fetch changes
+    WHERE pages come from, never what gets decoded — across every
+    cache dtype the pool supports. (The local hit is the oracle on
+    purpose: under a quantized cache a cold prefill attends
+    full-precision KV while any hit decodes from quantized pages, so
+    cold-vs-hit is not a bitwise pair — local-hit-vs-remote-hit is.)"""
+    model, state = model_state
+    dtype = {"int8": jnp.int8, "fp8": "fp8"}.get(cache_dtype, jnp.bfloat16)
+    warm = [_request(100 + i) for i in range(4)]
+    # round-robin alternates shards per submission: shifting the
+    # replay by one lands every request on the OPPOSITE shard from
+    # its warm pass, so every admission exercises the fabric fetch
+    shifted = warm[1:] + warm[:1]
+
+    on = _mk_cluster(
+        model, state, _fabric_cfg(FabricConfig()), cache_dtype=dtype
+    )
+    on.run(warm)            # cold: fills each shard's cache
+    local = on.run(warm)    # local warm hits: the bitwise oracle
+    fab = on.fabric
+    l0, h0 = fab.cross_shard_lookups, fab.cross_shard_hits
+    cross = on.run(shifted)
+    assert fab.cross_shard_lookups > l0
+    assert fab.cross_shard_hits > h0
+    assert fab.pages_fetched > 0
+    assert "fabric" in on.transfer.ops_by_plane
+    # shifted[i] IS warm[(i+1) % n], served on the opposite shard from
+    # its pages' owner — and the stream must not care
+    n = len(warm)
+    for i, stream in enumerate(cross):
+        np.testing.assert_array_equal(
+            np.asarray(stream), np.asarray(local[(i + 1) % n])
+        )
+
+    if cache_dtype == "bf16":
+        # full-precision pages make cold == hit bitwise, so the
+        # fabric-OFF cluster replaying the same shifted trace (cold
+        # admissions on the un-warmed shard) pins the whole pipeline
+        off = _mk_cluster(model, state, _fabric_cfg(None), cache_dtype=dtype)
+        off.run(warm)
+        off_streams = off.run(shifted)
+        for a, b in zip(cross, off_streams):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # pin hygiene: nothing outstanding once every request retired
+    assert fab.index.outstanding_pins == 0
+
+
+def test_fabric_pins_release_on_retire_and_pool_stays_balanced(model_state):
+    """Cross-shard pins must release at retirement: after serving, the
+    directory holds zero outstanding pins and every page the fetches
+    borrowed is accounted — cached chains keep their refs, but dropping
+    every cache entry returns BOTH pools to pristine."""
+    model, state = model_state
+    warm = [_request(200 + i) for i in range(4)]
+    cluster = _mk_cluster(model, state, _fabric_cfg(FabricConfig()))
+    cluster.run(warm)
+    cluster.run(warm[1:] + warm[:1])
+    assert cluster.fabric.index.outstanding_pins == 0
+    assert cluster.fabric.pins_released > 0
+    for shard in cluster.shards:
+        batcher = shard.batcher
+        cache = batcher.prefix_cache
+        keys = [key for key, _, _, _ in cache.export_entries()]
+        dropped = cache.drop_entries(keys)
+        if dropped:
+            ids, alive = batcher._page_id_batch(dropped)
+            batcher.state = batcher._cache_unref(batcher.state, ids, alive)
+    _assert_cluster_pristine(cluster)
+
+
+def test_fabric_pins_survive_drain(model_state):
+    """Draining a shard while the fabric is on must not leak pins:
+    the drained shard's directory entries are retired and its
+    cross-shard borrows released before the worker goes dark."""
+    model, state = model_state
+    cluster = _mk_cluster(
+        model, state, _fabric_cfg(FabricConfig(), failover=True)
+    )
+    warm = [_request(300 + i) for i in range(4)]
+    cluster.run(warm)
+    cluster.run(warm[1:] + warm[:1])  # cross-shard traffic before drain
+    for req in warm:
+        cluster.submit(req)
+    outcome = cluster.drain(0)
+    assert outcome["target"]
+    drained = cluster.run_pending()
+    assert len(drained) == len(warm)
+    assert cluster.fabric.index.outstanding_pins == 0
+
+
+# -- standby mirror chaos ----------------------------------------------------
+
+
+def test_standby_killed_mid_mirror_primary_keeps_serving(model_state):
+    """Chaos on the mirror link: a standby that dies mid-mirror is
+    discarded — the primaries were only ever READ, so serving output
+    is unaffected — and a fresh standby re-syncs from live pages at
+    the next housekeeping pass."""
+    model, state = model_state
+    cluster = _mk_cluster(
+        model, state, _fabric_cfg(FabricConfig(standby=True), failover=True)
+    )
+    trace = [_request(400 + i) for i in range(4)]
+    base = cluster.run(trace)
+    fab = cluster.fabric
+    assert fab.standby is not None
+    assert fab.standbys_spawned == 1
+    assert fab.mirror.mirrored_pages > 0
+    assert "mirror" in cluster.transfer.ops_by_plane
+
+    # kill the mirror link: every hop INTO the standby fails until the
+    # transfer engine's retry budget burns terminal. Fresh requests
+    # make fresh cache pages, so the post-serve mirror sync actually
+    # moves (and dies); the primaries were only ever read
+    cluster.transfer.fail_next(3, worker="standby-0")
+    trace2 = [_request(420 + i) for i in range(4)]
+    survived = cluster.run(trace2)
+    assert len(survived) == len(trace2)
+    assert fab.standby_failures == 1
+    assert fab.standby is None
+
+    # the next pass spawns a FRESH standby, re-synced from live pages:
+    # the warm replay of the ORIGINAL trace still streams bitwise
+    mirrored_before = fab.mirror.mirrored_pages
+    replay = cluster.run(trace)
+    for a, b in zip(base, replay):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert fab.standby is not None
+    assert fab.standby.pool.name == "standby-1"
+    assert fab.standbys_spawned == 2
+    assert fab.mirror.mirrored_pages > mirrored_before
+    assert fab.index.outstanding_pins == 0
+
+
+def test_standby_promotion_recovers_bitwise(model_state):
+    """The near-zero-failover acceptance leg: kill a decode shard
+    mid-stream with the dark standby armed — recovery promotes the
+    standby (pin adoption, no re-prefill replay) and the recovered
+    streams are bitwise-identical to the uninterrupted warm pass."""
+    model, state = model_state
+    cluster = _mk_cluster(
+        model, state, _fabric_cfg(FabricConfig(standby=True), failover=True)
+    )
+    trace = [_request(500 + i) for i in range(4)]
+    cluster.run(trace)        # compile + fill caches (+ mirror)
+    base = cluster.run(trace)  # warm-hit pass: the bitwise oracle
+    inject_worker_fault(
+        cluster, WorkerFault("decode-1", "kill", after_dispatches=0)
+    )
+    recovered = cluster.run(trace)
+    for a, b in zip(base, recovered):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    fab = cluster.fabric
+    assert fab.promotions == 1
+    assert fab.index.outstanding_pins == 0
+    # the promoted standby is a full shard now; every pool balanced
+    names = [s.pool.name for s in cluster.shards]
+    assert any(n.startswith("standby-") for n in names)
+    for shard in cluster.shards:
+        if shard.pool.name == "decode-1":
+            continue  # the killed worker's pool is out of service
+        st = jax.device_get(shard.batcher.state)
+        assert int(st.free_top) >= 0
+
+
+def test_fabric_off_cluster_has_no_engine(model_state):
+    """Default OFF: a fabric-less cluster carries no engine, no
+    fabric/mirror transfer planes, and no standby — the pre-fabric
+    topology exactly."""
+    model, state = model_state
+    cluster = _mk_cluster(model, state, _fabric_cfg(None))
+    cluster.run([_request(600 + i) for i in range(2)])
+    assert cluster.fabric is None
+    assert "fabric" not in cluster.transfer.ops_by_plane
+    assert "mirror" not in cluster.transfer.ops_by_plane
+    assert all(
+        s.pool.name.startswith("decode-") for s in cluster.shards
+    )
+
+
+# -- federated incident traces ----------------------------------------------
+
+
+def test_incident_trace_federates_across_plane_rings():
+    """Satellite: an incident-kept trace is assembled from the MERGED
+    cluster flight plane (every worker's ring, skew-aligned) instead
+    of the local buffer, is marked ``federated``, and serves that flag
+    at /debug/traces/<id>."""
+    from beholder_tpu.obs import (
+        FlightRecorder,
+        RetentionConfig,
+        TraceVault,
+    )
+    from beholder_tpu.obs.flightplane import FlightPlane
+
+    plane = FlightPlane(worker="decode-0")
+    recorder = plane.bind(FlightRecorder())
+    vault = TraceVault(RetentionConfig(incident_budget=4))
+    vault.link_flight_plane(plane)
+    vault.open_incident("chaos: mirror link down")
+
+    trace = "tr-fed-0"
+    # one request's lifecycle spanning two workers: the claim lands on
+    # decode-0's track, the recovery leg on decode-1's — exactly the
+    # cross-worker story a local ring cannot assemble alone
+    recorder.instant("req.claim", trace_id=trace, gid="g-fed", slot=0)
+    recorder.instant(
+        "handoff.recv", trace_id=trace, gid="g-fed", worker="decode-1"
+    )
+    recorder.instant(
+        "req.retire", trace_id=trace, gid="g-fed", worker="decode-1",
+        tokens=4, outcome="ok",
+    )
+    assert len(plane.rings()) >= 2
+
+    # the vault folds the same lifecycle (claim -> retire) and keeps it
+    # on the open incident; the keep path swaps in the federated merge
+    vault.on_event(
+        {
+            "name": "req.claim", "ph": "i", "ts_us": 1_000,
+            "trace_id": trace, "args": {"gid": "g-fed", "slot": 0},
+        }
+    )
+    vault.on_event(
+        {
+            "name": "req.retire", "ph": "i", "ts_us": 90_000,
+            "trace_id": trace,
+            "args": {"gid": "g-fed", "tokens": 4, "outcome": "ok"},
+        }
+    )
+    assert vault.federated == 1
+    vault_id = vault.trace_ref("g-fed")
+    assert vault_id is not None
+
+    metrics = Metrics()
+    metrics.add_route("/debug/traces/", vault.trace_route())
+    port = metrics.expose(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/traces/{vault_id}"
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["federated"] is True
+        # the merged assembly carries BOTH workers' legs
+        workers = {
+            e.get("args", {}).get("worker")
+            for e in doc["traceEvents"]
+            if isinstance(e, dict)
+        }
+        assert len(workers - {None}) >= 1
+    finally:
+        metrics.close()
+
+
+def test_federation_falls_back_to_local_on_single_ring():
+    """With only one ring on the plane there is nothing to merge:
+    federation abstains and the incident keep falls back to the local
+    assembly, unmarked."""
+    from beholder_tpu.obs import (
+        FlightRecorder,
+        RetentionConfig,
+        TraceVault,
+    )
+    from beholder_tpu.obs.flightplane import FlightPlane
+
+    plane = FlightPlane(worker="decode-0")
+    recorder = plane.bind(FlightRecorder())
+    vault = TraceVault(RetentionConfig(incident_budget=4))
+    vault.link_flight_plane(plane)
+    vault.open_incident("chaos: solo")
+    recorder.instant("req.claim", trace_id="tr-solo", gid="g-solo")
+    vault.on_event(
+        {
+            "name": "req.claim", "ph": "i", "ts_us": 1_000,
+            "trace_id": "tr-solo", "args": {"gid": "g-solo", "slot": 0},
+        }
+    )
+    vault.on_event(
+        {
+            "name": "req.retire", "ph": "i", "ts_us": 50_000,
+            "trace_id": "tr-solo",
+            "args": {"gid": "g-solo", "tokens": 2, "outcome": "ok"},
+        }
+    )
+    assert vault.federated == 0
+    vault_id = vault.trace_ref("g-solo")
+    assert vault_id is not None
