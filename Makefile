@@ -10,7 +10,7 @@ PYTHON ?= python3
 # loader also accepts the plain name for pre-existing builds.
 EXT_SUFFIX := $(shell $(PYTHON) -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
 
-.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention bench-capacity bench-fabric perf-gate lint clean
+.PHONY: all proto native test bench bench-cache bench-spec bench-cluster bench-failover bench-slo bench-kernel bench-ingest bench-control bench-flight bench-retention bench-capacity bench-fabric bench-group perf-gate lint clean
 
 all: proto native
 
@@ -168,6 +168,19 @@ bench-fabric:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python bench.py --fabric-only
 
+# the group-parallel-decode scenario alone: a group-of-2 shard_map
+# engine (pool partitioned by KV head, one program per tick) vs the
+# single-device engine on the same decode-heavy trace, streams
+# asserted bitwise-identical BEFORE timing, then both re-timed
+# interleaved (group/single per-token wall is the ratio the perf gate
+# bands, higher fails — on the CPU mesh the tiled all_gather
+# reassembly is a pure emulated-collective tax the band caps). Writes
+# artifacts/bench_group.json (schema v16 group block); same
+# forced-mesh trick so the group members sit on real device boundaries
+bench-group:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench.py --group-only
+
 # the drift-proof perf gate on the COMMITTED schema-v5 artifacts: a
 # self-compare is the wiring check (every ratio extractor must resolve
 # and every noise band must hold at ratio 1.0). CI runs the real
@@ -198,6 +211,8 @@ perf-gate:
 		--baseline artifacts/bench_capacity.json --current artifacts/bench_capacity.json
 	python -m beholder_tpu.tools.perf_gate \
 		--baseline artifacts/bench_fabric.json --current artifacts/bench_fabric.json
+	python -m beholder_tpu.tools.perf_gate \
+		--baseline artifacts/bench_group.json --current artifacts/bench_group.json
 
 lint:
 	@if python -c "import importlib.util,sys; sys.exit(0 if importlib.util.find_spec('ruff') else 1)"; then \

@@ -86,7 +86,12 @@ Schema (``validate`` is the authoritative checker)::
                  "mirrored_pages": 0.0,
                  "replayed_recovery_ms": 0.0,
                  "replica_recovery_ms": 0.0,
-                 "replica_recovery_ratio": 0.0}  # v15: memory fabric
+                 "replica_recovery_ratio": 0.0},  # v15: memory fabric
+      "group": {"group_size": 0.0,
+                "decode_ticks": 0.0,
+                "single_decode_ms_per_tok": 0.0,
+                "group_decode_ms_per_tok": 0.0,
+                "group_decode_latency_ratio": 0.0}  # v16: group decode
     }
 
 Schema v2 (the reliability PR): every artifact carries the run's
@@ -222,6 +227,18 @@ interleaved in the same session after bitwise stream asserts, with
 recovered faster than replay — the figure the standby mirror exists
 to move; banded, degradation = the ratio FALLING). v1-v14 artifacts
 remain valid.
+
+Schema v16 (the group-parallel-decode PR): the run's group-decode
+evidence rides along (:meth:`ArtifactRecorder.record_group`) —
+per-token decode wall for a group-of-N shard (one shard_map program
+per tick, pool partitioned by KV head) vs the single-device engine on
+the SAME trace, both measured interleaved in the same session AFTER
+the streams are asserted bitwise-identical, with
+``group_decode_latency_ratio`` (group / single; the perf gate bands
+it HIGHER-fails — on the CPU mesh the tiled all_gather reassembly is
+a pure tax, so the band caps how much tax the group tick may pay; on
+real accelerators the ICI gathers overlap and the ratio is the figure
+group serving exists to move below 1). v1-v15 artifacts remain valid.
 """
 
 from __future__ import annotations
@@ -233,7 +250,7 @@ import time
 from typing import Any
 
 SCHEMA = "beholder-bench-artifact"
-SCHEMA_VERSION = 15
+SCHEMA_VERSION = 16
 
 #: v5: the attribution block's required shape (an empty summary is
 #: valid — a run that never armed the flight recorder still writes a
@@ -394,6 +411,17 @@ EMPTY_FABRIC = {
     "replica_recovery_ratio": 0.0,
 }
 
+#: v16: the group-decode block's required shape (an empty block is
+#: valid — a run that never built a group shard still writes a v16
+#: artifact)
+EMPTY_GROUP = {
+    "group_size": 0.0,
+    "decode_ticks": 0.0,
+    "single_decode_ms_per_tok": 0.0,
+    "group_decode_ms_per_tok": 0.0,
+    "group_decode_latency_ratio": 0.0,
+}
+
 #: default artifact directory: <repo root>/artifacts, independent of cwd
 DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts"
@@ -482,6 +510,7 @@ class ArtifactRecorder:
         self.retention: dict[str, float] = dict(EMPTY_RETENTION)
         self.capacity: dict[str, float] = dict(EMPTY_CAPACITY)
         self.fabric: dict[str, float] = dict(EMPTY_FABRIC)
+        self.group: dict[str, float] = dict(EMPTY_GROUP)
 
     def section(
         self,
@@ -724,6 +753,19 @@ class ArtifactRecorder:
             key: float(summary[key]) for key in EMPTY_FABRIC
         }
 
+    def record_group(self, summary: dict[str, Any]) -> None:
+        """Adopt one group-parallel-decode summary (bench_group's
+        interleaved group-vs-single per-token decode walls, measured
+        after the streams are asserted bitwise-identical) as the run's
+        v16 ``group`` block. Last writer wins — the block carries the
+        HEADLINE collective-tax comparison for the group tick."""
+        for key in EMPTY_GROUP:
+            if key not in summary:
+                raise ValueError(f"group summary missing {key!r}")
+        self.group = {
+            key: float(summary[key]) for key in EMPTY_GROUP
+        }
+
     def record_attribution(self, summary: dict[str, Any]) -> None:
         """Adopt one flight-recorder roofline summary
         (:func:`beholder_tpu.obs.attribution_summary`) as the run's v5
@@ -776,6 +818,7 @@ class ArtifactRecorder:
             "retention": dict(self.retention),
             "capacity": dict(self.capacity),
             "fabric": dict(self.fabric),
+            "group": dict(self.group),
         }
 
     def write(self, path: str | None = None) -> str:
@@ -922,6 +965,14 @@ def record_fabric(summary: dict) -> None:
     as :func:`record_raw`)."""
     if _CURRENT is not None:
         _CURRENT.record_fabric(summary)
+
+
+def record_group(summary: dict) -> None:
+    """Adopt a group-parallel-decode summary into the active
+    recorder's v16 ``group`` block; no-op without one (same contract
+    as :func:`record_raw`)."""
+    if _CURRENT is not None:
+        _CURRENT.record_group(summary)
 
 
 # -- validation ---------------------------------------------------------------
@@ -1164,6 +1215,18 @@ def validate(obj: Any) -> None:
                     problems.append(
                         f"fabric.{key} must be a number, "
                         f"got {fabric.get(key)!r}"
+                    )
+    if isinstance(version, int) and version >= 16:
+        # v16: group-parallel-decode evidence
+        group = obj.get("group")
+        if not isinstance(group, dict):
+            problems.append("group must be a dict (schema v16+)")
+        else:
+            for key in EMPTY_GROUP:
+                if not isinstance(group.get(key), (int, float)):
+                    problems.append(
+                        f"group.{key} must be a number, "
+                        f"got {group.get(key)!r}"
                     )
     raw = obj.get("raw_timings")
     if not isinstance(raw, list):

@@ -44,6 +44,13 @@ submit/run API, same bitwise outputs, N× the pool:
   page fetch away on every other shard, plus an optional dark standby
   shard mirroring live pages so failover becomes promotion + pin
   adoption instead of re-prefill.
+- **Group-parallel decode** (:mod:`.group`, ``instance.cluster.
+  group.*`` — default OFF, under which every decode shard stays
+  single-device): a group of N mesh devices serves ONE logical shard —
+  params at rest in the megatron tp shardings, the paged pool
+  partitioned by KV head, one shard_map program per tick, the head
+  axis reassembled by a tiled all_gather (never a psum). The
+  scheduler, fabric and failover see one routable shard.
 
 **Exactness.** Under exact greedy the cluster emits token streams
 bitwise-identical to the single-device engine on the same request
@@ -146,6 +153,55 @@ class FabricConfig:
 
 
 @dataclass
+class GroupConfig:
+    """Group-parallel-decode knobs (``instance.cluster.group.*``).
+
+    None on :class:`ClusterConfig` (the default) keeps every decode
+    shard single-device: serving output, handoff wire bytes, and the
+    /metrics exposition byte-identical to the pre-group cluster. Set,
+    every decode shard becomes a GROUP of ``size`` mesh devices serving
+    ONE logical shard (:class:`~beholder_tpu.cluster.group.
+    GroupBatcher`): params at rest in the megatron tp shardings, the
+    paged pool partitioned by KV head, one shard_map program per tick.
+    Exact-greedy group streams are bitwise-identical to the
+    single-device engine (pinned by ``tests/test_group.py``)."""
+
+    #: devices per decode group (>= 2 — a group of 1 IS the plain
+    #: single-device shard, so asking for it is a config error, not a
+    #: silent no-op); must divide the model's KV-head count and the
+    #: mesh's device count
+    size: int = 2
+    #: mesh-axis name the group's collectives run over — the params'
+    #: tp axis (``seq_state_shardings`` specs name it), so trained
+    #: sharded params drop in without a respec
+    axis: str = "tp"
+    #: pool-partition policy. Only ``"kv_head"`` exists: member m owns
+    #: heads [m*Hkv/size, (m+1)*Hkv/size) of every page, which is what
+    #: keeps every allocator invariant member-local by construction.
+    #: The field is explicit (not implied) so a future page-partition
+    #: policy is a VALUE, not a schema change.
+    head_partition: str = "kv_head"
+
+    def __post_init__(self):
+        if self.size < 2:
+            raise ValueError(
+                f"group size must be >= 2, got {self.size} (size 1 is "
+                "the plain single-device shard — disable the group "
+                "block instead)"
+            )
+        if not str(self.axis).isidentifier():
+            raise ValueError(
+                f"group axis must be a mesh-axis identifier, "
+                f"got {self.axis!r}"
+            )
+        if self.head_partition != "kv_head":
+            raise ValueError(
+                f"head_partition must be 'kv_head', "
+                f"got {self.head_partition!r}"
+            )
+
+
+@dataclass
 class ClusterConfig:
     """Cluster-serving knobs (``instance.cluster.*``).
 
@@ -169,6 +225,9 @@ class ClusterConfig:
     #: cluster memory fabric: None (the default) keeps per-shard
     #: prefix caches private and failover on the replay path
     fabric: FabricConfig | None = None
+    #: group-parallel decode: None (the default) keeps decode shards
+    #: single-device
+    group: GroupConfig | None = None
 
     def __post_init__(self):
         if self.n_decode_workers < 1:
@@ -222,6 +281,16 @@ def cluster_from_config(config) -> ClusterConfig | None:
             replicate_after=int(config.get(f"{fb}.replicate_after", 2)),
             standby=bool(config.get(f"{fb}.standby", False)),
         )
+    group = None
+    if bool(config.get("instance.cluster.group.enabled")):
+        gp = "instance.cluster.group"
+        group = GroupConfig(
+            size=int(config.get(f"{gp}.size", 2)),
+            axis=str(config.get(f"{gp}.axis", "tp")),
+            head_partition=str(
+                config.get(f"{gp}.head_partition", "kv_head")
+            ),
+        )
     return ClusterConfig(
         n_decode_workers=int(
             config.get("instance.cluster.n_decode_workers", 2)
@@ -240,6 +309,7 @@ def cluster_from_config(config) -> ClusterConfig | None:
         ),
         failover=failover,
         fabric=fabric,
+        group=group,
     )
 
 
@@ -247,6 +317,7 @@ __all__ = [
     "ClusterConfig",
     "FabricConfig",
     "FailoverConfig",
+    "GroupConfig",
     "ROUTE_PRESSURE",
     "ROUTE_ROUND_ROBIN",
     "cluster_from_config",

@@ -212,11 +212,6 @@ class FabricEngine:
         import jax
         import jax.numpy as jnp
 
-        from beholder_tpu.models.serving import (
-            paged_export_pages,
-            paged_import_pages,
-        )
-
         src_name, dst_name = src.pool.name, dst.pool.name
         n = len(page_ids)
         fr = self.flight_recorder
@@ -230,20 +225,20 @@ class FabricEngine:
         t0 = time.perf_counter()
         padded = list(page_ids)
         padded += [padded[-1]] * (-n % self.MOVE_BUCKET)
-        chunks_k, chunks_v = paged_export_pages(
-            src.batcher.state, jnp.asarray(padded, jnp.int32)
+        # export/import through the batcher's wire methods: a group
+        # shard merges member head-slices on export and re-slices on
+        # import, so fabric peers speak ONE full-head dialect whether
+        # either endpoint is grouped or not
+        chunks_k, chunks_v = src.batcher.export_pages(
+            jnp.asarray(padded, jnp.int32)
         )
-        try:
-            dst_device = next(iter(dst.batcher.state.seq_lens.devices()))
-        except Exception:  # noqa: BLE001 - uncommitted single-device state
-            dst_device = None
         chunks_k, chunks_v = self.transfer.raw_move(
-            (chunks_k, chunks_v), dst_device,
+            (chunks_k, chunks_v), dst.batcher.transfer_device,
             src=src_name, dst=dst_name,
             op=f"{plane}.{src_name}->{dst_name}",
         )
-        new_state, dest = paged_import_pages(
-            dst.batcher.state, chunks_k, chunks_v,
+        new_state, dest = dst.batcher.import_pages(
+            chunks_k, chunks_v,
             jnp.int32(n), jnp.ones(len(padded), jnp.int32),
         )
         dst.batcher.state = new_state
@@ -397,7 +392,22 @@ class FabricEngine:
     def _spawn_standby(self, scheduler) -> None:
         from beholder_tpu.parallel.mesh import serving_shard_devices
 
-        device = serving_shard_devices(scheduler._devices_used + 1)[-1]
+        gcfg = scheduler.cluster.group
+        if gcfg is not None:
+            # standbys stay SINGLE-DEVICE even when primaries are
+            # grouped: the mirror's wire format is the full-head
+            # dialect either way, and promotion is bitwise because
+            # group == single is pinned. Place it on the first device
+            # after the used group blocks (one block is consumed from
+            # the cycle — the accepted co-location rule covers the
+            # remainder).
+            device = serving_shard_devices(
+                scheduler._devices_used * gcfg.size + 1
+            )[-1]
+        else:
+            device = serving_shard_devices(
+                scheduler._devices_used + 1
+            )[-1]
         scheduler._devices_used += 1
         n = self.standbys_spawned
         self.standbys_spawned += 1

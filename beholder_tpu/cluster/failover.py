@@ -629,11 +629,6 @@ def migrate_pool(src_batcher, dst_batcher, transfer=None, *,
     import jax
     import jax.numpy as jnp
 
-    from beholder_tpu.models.serving import (
-        paged_export_pages,
-        paged_import_pages,
-    )
-
     def snapshot():
         state = src_batcher.state
         table, lens, active, refs = (
@@ -675,16 +670,14 @@ def migrate_pool(src_batcher, dst_batcher, transfer=None, *,
                 f"{src_slots.size} live source slots"
             )
 
-    # the raw move: export in pool representation, one retried device
-    # hop, import verbatim with the SOURCE refcounts
+    # the raw move: export in pool representation (a group shard's
+    # export merges member head-slices back to the single-device
+    # full-head wire dialect), one retried device hop to the
+    # destination batcher's wire endpoint (member 0 for a group),
+    # import verbatim with the SOURCE refcounts
     ids = jnp.asarray(live, jnp.int32)
-    chunks_k, chunks_v = paged_export_pages(src_batcher.state, ids)
-    # destination = wherever the dst pool lives (committed by
-    # place_paged_state); None degrades to the no-hop local path
-    try:
-        dst_device = next(iter(dst_batcher.state.seq_lens.devices()))
-    except Exception:  # noqa: BLE001 - uncommitted single-device state
-        dst_device = None
+    chunks_k, chunks_v = src_batcher.export_pages(ids)
+    dst_device = dst_batcher.transfer_device
     if transfer is not None:
         chunks_k, chunks_v = transfer.raw_move(
             (chunks_k, chunks_v), dst_device,
@@ -695,9 +688,8 @@ def migrate_pool(src_batcher, dst_batcher, transfer=None, *,
             (chunks_k, chunks_v), dst_device
         )
     ref_vals = jnp.asarray(refs[live], jnp.int32)
-    new_state, dest = paged_import_pages(
-        dst_batcher.state, chunks_k, chunks_v,
-        jnp.int32(int(live.size)), ref_vals,
+    new_state, dest = dst_batcher.import_pages(
+        chunks_k, chunks_v, jnp.int32(int(live.size)), ref_vals,
     )
     dest = np.asarray(jax.device_get(dest))[: live.size]
     mapping = {int(o): int(d) for o, d in zip(live, dest)}

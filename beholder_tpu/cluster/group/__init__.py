@@ -1,0 +1,46 @@
+"""Group-parallel decode: serve the decode tick itself across chips.
+
+EXTENSION BEYOND THE REFERENCE. The cluster partitions REQUESTS — every
+shard's model and KV pool must fit one device, and the memory fabric
+(PR 18) can move pages between shards but cannot make a bigger model or
+a faster token. This subsystem partitions the TICK: a group of N mesh
+devices serves ONE logical shard (``instance.cluster.group.*`` —
+default OFF, under which serving output, wire bytes, and the /metrics
+exposition stay byte-identical):
+
+- **Params** lie at rest in the existing :mod:`beholder_tpu.parallel`
+  megatron column→row tensor-parallel shardings over the group's
+  ``(1, N)`` dp×tp mesh — the machinery trained models already use,
+  now wired into serving.
+- **The paged KV pool partitions by KV HEAD**: member ``m`` holds every
+  page's heads ``[m*Hkv/N, (m+1)*Hkv/N)``. Page tables, free stacks,
+  refcounts and lengths are REPLICATED — allocator arithmetic is
+  head-free, so every member evolves in bitwise lockstep and every
+  pinned allocator invariant holds member-locally by construction.
+  Page ids are group-global: the prefix cache, fabric directory and
+  host arithmetic never learn the pool was split.
+- **One program per tick**: claim → admit → tick → retire → packed
+  readback dispatch ``shard_map`` programs over the group; attention
+  runs on member-local heads and one tiled ``all_gather`` reassembles
+  the head dim (pure data movement — bitwise, unlike a psum).
+- **The scheduler sees ONE shard**: a group routes, drains, fails over
+  and mirrors as a single ``decode-g<id>`` worker; flight-plane events
+  carry ``worker=decode-g0.m1`` member identities.
+
+Exact-greedy group streams are ``np.array_equal`` to the single-device
+engine for bf16/int8/fp8 pools (pinned by ``tests/test_group.py``).
+The device half lives in :mod:`.engine` and loads on first use — this
+module stays import-light.
+"""
+
+from __future__ import annotations
+
+__all__ = ["GroupBatcher"]
+
+
+def __getattr__(name):
+    if name == "GroupBatcher":
+        from .engine import GroupBatcher
+
+        return GroupBatcher
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -135,14 +135,35 @@ class ClusterScheduler:
             self.instruments = ClusterMetrics(self._registry)
             self.instruments.shards.set(cluster.n_decode_workers)
 
-        n_workers = cluster.n_decode_workers + cluster.n_prefill_workers
-        devices = serving_shard_devices(n_workers)
-        #: devices handed out so far — scale_up() continues the cycle
-        self._devices_used = n_workers
+        if cluster.group is not None:
+            # group-parallel decode: each decode shard owns a
+            # CONTIGUOUS block of group.size devices; prefill workers
+            # stay single-device, continuing the cycle after the
+            # decode blocks
+            gsz = cluster.group.size
+            decode_devices = serving_shard_devices(
+                cluster.n_decode_workers, group_size=gsz
+            )
+            singles = serving_shard_devices(
+                cluster.n_decode_workers * gsz + cluster.n_prefill_workers
+            )
+            prefill_devices = singles[cluster.n_decode_workers * gsz:]
+            #: group blocks handed out so far — scale_up() continues
+            #: the BLOCK cycle (prefill singles may co-locate round-
+            #: robin with a block, the same accepted co-location rule
+            #: as an oversubscribed single-device cluster)
+            self._devices_used = cluster.n_decode_workers
+        else:
+            n_workers = cluster.n_decode_workers + cluster.n_prefill_workers
+            devices = serving_shard_devices(n_workers)
+            decode_devices = devices[: cluster.n_decode_workers]
+            prefill_devices = devices[cluster.n_decode_workers :]
+            #: devices handed out so far — scale_up() continues the cycle
+            self._devices_used = n_workers
 
         self.shards: list[_Shard] = []
         for i in range(cluster.n_decode_workers):
-            self.shards.append(self._build_shard(i, devices[i]))
+            self.shards.append(self._build_shard(i, decode_devices[i]))
         self.pool_view = ShardedPoolView([s.pool for s in self.shards])
 
         self.prefill_workers: list[PrefillWorker] = [
@@ -150,7 +171,7 @@ class ClusterScheduler:
                 model,
                 params,
                 batcher_kwargs.get("page_size", 16),
-                device=devices[cluster.n_decode_workers + j],
+                device=prefill_devices[j],
                 name=f"prefill-{j}",
             )
             for j in range(cluster.n_prefill_workers)
@@ -218,9 +239,7 @@ class ClusterScheduler:
         from beholder_tpu.models.serving import ContinuousBatcher
         from beholder_tpu.reliability.shed import IntakeQueue
 
-        batcher = ContinuousBatcher(
-            self.model,
-            self.params,
+        shared_kwargs = dict(
             metrics=self._metrics,
             tracer=self._tracer,
             flight_recorder=self.flight_recorder,
@@ -232,14 +251,45 @@ class ClusterScheduler:
             spec=self._spec,
             **self._batcher_kwargs,
         )
-        # the pool partition IS the placement: this shard's pages,
-        # page table and params live on their own mesh device, so
-        # every dispatch the shard runs lands there
-        batcher.state = place_paged_state(batcher.state, device)
-        batcher.params = place_paged_state(batcher.params, device)
-        pool = ShardPool(shard_id, batcher.num_pages, device=device)
-        if name is not None:
-            pool.name = name
+        if isinstance(device, tuple):
+            # group-parallel decode shard: the device tuple IS the
+            # group; the GroupBatcher places its own state (pools
+            # sharded by KV head over the group mesh, params in the
+            # megatron tp shardings), so the single-device
+            # place_paged_state below must not touch it. The pool's
+            # routable device is the group's wire endpoint (member 0).
+            from .group.engine import GroupBatcher
+
+            gname = name if name is not None else f"decode-g{shard_id}"
+            batcher = GroupBatcher(
+                self.model,
+                self.params,
+                devices=device,
+                axis=(
+                    self.cluster.group.axis
+                    if self.cluster.group is not None
+                    else "tp"
+                ),
+                name=gname,
+                **shared_kwargs,
+            )
+            pool = ShardPool(
+                shard_id, batcher.num_pages,
+                device=batcher.transfer_device,
+            )
+            pool.name = gname
+        else:
+            batcher = ContinuousBatcher(
+                self.model, self.params, **shared_kwargs
+            )
+            # the pool partition IS the placement: this shard's pages,
+            # page table and params live on their own mesh device, so
+            # every dispatch the shard runs lands there
+            batcher.state = place_paged_state(batcher.state, device)
+            batcher.params = place_paged_state(batcher.params, device)
+            pool = ShardPool(shard_id, batcher.num_pages, device=device)
+            if name is not None:
+                pool.name = name
         # the router owns the shard intakes: queued items are
         # (submit sequence, request) pairs so run_pending() can
         # hand results back in ADMISSION order across the whole
@@ -314,7 +364,15 @@ class ClusterScheduler:
         direction lose nothing."""
         from beholder_tpu.parallel.mesh import serving_shard_devices
 
-        device = serving_shard_devices(self._devices_used + 1)[-1]
+        if self.cluster.group is not None:
+            # the block cycle: a spawned group shard claims the next
+            # CONTIGUOUS device block, same shape as boot-time groups
+            device = serving_shard_devices(
+                self._devices_used + 1,
+                group_size=self.cluster.group.size,
+            )[-1]
+        else:
+            device = serving_shard_devices(self._devices_used + 1)[-1]
         self._devices_used += 1
         shard = self._build_shard(len(self.shards), device)
         self.shards.append(shard)

@@ -42,6 +42,7 @@ from beholder_tpu.ops.flash_attention import flash_attention
 from beholder_tpu.ops.moe import SwitchFFN
 from beholder_tpu.ops.paged_attention import (
     ChunkPagedInfo,
+    GroupSpec,
     PagedInfo,
     QuantizedPool,
     paged_chunk_attention,
@@ -75,6 +76,19 @@ def _pool_write_column(pool, info: PagedInfo, col: jax.Array):
     return pool.at[info.write_pages, :, :, info.write_offsets].set(
         col.astype(pool.dtype), mode="drop"
     )
+
+
+def _group_slice(x: jax.Array, group: GroupSpec, width: int) -> jax.Array:
+    """This group member's head slice of ``x`` (head axis 1): member
+    ``m`` of the ``group.axis`` mesh axis owns heads
+    ``[m*width, (m+1)*width)``. Contiguous by construction — GQA groups
+    q heads contiguously per kv head (the ``bhgqd`` reshape in the
+    dense branch), so slicing ``width = hkv_loc`` kv heads and
+    ``width = hkv_loc * g`` q heads at the matching offset keeps every
+    q head next to its kv head. Only meaningful inside a ``shard_map``
+    over ``group.axis``."""
+    m = jax.lax.axis_index(group.axis)
+    return jax.lax.dynamic_slice_in_dim(x, m * width, width, axis=1)
 
 
 def _seq_shard_constraint(mesh: Mesh | None, x: jax.Array) -> jax.Array:
@@ -134,16 +148,38 @@ class Block(nn.Module):
     window: int | None = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, cache=None, return_kv: bool = False):
+    def __call__(
+        self,
+        x: jax.Array,
+        cache=None,
+        return_kv: bool = False,
+        group: GroupSpec | None = None,
+    ):
         """Training/scoring forward, or — with ``cache=(k, v, index)`` —
         one KV-cached decode step on a (B, 1, D) input (see
-        :mod:`beholder_tpu.models.decode`)."""
+        :mod:`beholder_tpu.models.decode`).
+
+        With ``group`` (inside a ``shard_map`` over ``group.axis`` —
+        group-parallel decode, :mod:`beholder_tpu.cluster.group`) the
+        paged branches run MEMBER-LOCAL: the pools in ``cache`` carry
+        this member's ``hkv/group.size`` kv-head slice, q/k/v
+        projections are head-sliced to match, attention runs on local
+        heads only, and a tiled ``all_gather`` reassembles the full
+        head dim before the (replicated) output projection — bitwise
+        the single-device forward, because head-sliced attention
+        touches exactly the same values per head and the gather is
+        pure data movement. Paged branches only; anything else raises."""
         b, t, d = x.shape
         h = self.heads
         hkv = self.kv_heads or h
         dh = d // h
         if h % hkv:
             raise ValueError(f"heads {h} not a multiple of kv_heads {hkv}")
+        if group is not None and hkv % group.size:
+            raise ValueError(
+                f"group size {group.size} must divide kv_heads {hkv} "
+                "(each member holds whole kv heads)"
+            )
         if self.seq_shard:
             x = _seq_shard_constraint(self.mesh, x)
         y = nn.LayerNorm()(x)
@@ -161,17 +197,34 @@ class Block(nn.Module):
         )
         if cache is not None:
             k_cache, v_cache, index = cache
+            if group is not None and not isinstance(
+                index, (PagedInfo, ChunkPagedInfo)
+            ):
+                raise ValueError(
+                    "group-parallel forwards are paged-only (PagedInfo "
+                    f"or ChunkPagedInfo cache index), got {type(index)}"
+                )
             if isinstance(index, PagedInfo):
                 # paged serving: scatter the new kv column into this
                 # slot's page (OOB page ids drop — inactive slots), then
                 # attend the slot's pages IN PLACE via the page table
                 # inside the Pallas decode kernel. t must be 1 here;
                 # execution falls through to the shared proj/FFN tail.
-                k_cache = _pool_write_column(k_cache, index, k[:, :, 0, :])
-                v_cache = _pool_write_column(v_cache, index, v[:, :, 0, :])
+                q_col, k_col, v_col = q[:, :, 0, :], k[:, :, 0, :], v[:, :, 0, :]
+                if group is not None:
+                    # member-local tick: slice this member's kv heads
+                    # out of the full projections BEFORE the pool write
+                    # (quantize/slice commute — per-(head, token)
+                    # scales), attend local heads, gather back to full
+                    hloc = hkv // group.size
+                    k_col = _group_slice(k_col, group, hloc)
+                    v_col = _group_slice(v_col, group, hloc)
+                    q_col = _group_slice(q_col, group, hloc * (h // hkv))
+                k_cache = _pool_write_column(k_cache, index, k_col)
+                v_cache = _pool_write_column(v_cache, index, v_col)
                 quant = isinstance(k_cache, QuantizedPool)
                 att = paged_decode_attention(
-                    q[:, :, 0, :],
+                    q_col,
                     k_cache.values if quant else k_cache,
                     v_cache.values if quant else v_cache,
                     index.page_table,
@@ -179,7 +232,12 @@ class Block(nn.Module):
                     window=self.window,
                     k_scale=k_cache.scales if quant else None,
                     v_scale=v_cache.scales if quant else None,
-                )[:, :, None, :]                         # (S, H, 1, Dh)
+                )
+                if group is not None:
+                    att = jax.lax.all_gather(
+                        att, group.axis, axis=1, tiled=True
+                    )
+                att = att[:, :, None, :]                 # (S, H, 1, Dh)
                 kv_out = (k_cache, v_cache)
             elif isinstance(index, ChunkPagedInfo):
                 # fused chunk attention (spec verify / prefix-suffix
@@ -191,6 +249,15 @@ class Block(nn.Module):
                 # columns it keeps (accepted prefix / suffix pages).
                 # Bitwise-identical to the dense-gather branch below
                 # (pinned by tests/test_paged_chunk_kernel.py).
+                if group is not None:
+                    # member-local chunk: head-slice q and the chunk's
+                    # own kv overlay; the pools are already this
+                    # member's slice. kv_out is the LOCAL columns, so
+                    # the caller's scatter lands in the local pool.
+                    hloc = hkv // group.size
+                    k = _group_slice(k, group, hloc)
+                    v = _group_slice(v, group, hloc)
+                    q = _group_slice(q, group, hloc * (h // hkv))
                 quant = isinstance(k_cache, QuantizedPool)
                 att = paged_chunk_attention(
                     q, k, v,
@@ -203,7 +270,12 @@ class Block(nn.Module):
                     window=self.window,
                     k_scale=k_cache.scales if quant else None,
                     v_scale=v_cache.scales if quant else None,
+                    group=1 if group is None else group.size,
                 )                                        # (S, H, t, Dh)
+                if group is not None:
+                    att = jax.lax.all_gather(
+                        att, group.axis, axis=1, tiled=True
+                    )
                 kv_out = (k, v)      # the chunk's OWN hkv-head columns
             else:
                 if getattr(index, "ndim", 0) == 1:
@@ -297,6 +369,11 @@ class Block(nn.Module):
                 ).reshape(b, h, t, dh)
                 kv_out = (k_cache, v_cache)
         else:
+            if group is not None:
+                raise ValueError(
+                    "group-parallel forwards need a paged cache; the "
+                    "training/prefill paths stay single-device full-head"
+                )
             if self.attention in ("ring", "ulysses") and self.mesh is None:
                 raise ValueError(f"{self.attention} attention needs a mesh")
             kv_out = (k, v)  # cache k/v keep their hkv heads
@@ -370,12 +447,21 @@ class TelemetrySequenceModel(nn.Module):
     window: int | None = None
 
     @nn.compact
-    def __call__(self, feats: jax.Array, cache=None, return_kv: bool = False):
+    def __call__(
+        self,
+        feats: jax.Array,
+        cache=None,
+        return_kv: bool = False,
+        group: GroupSpec | None = None,
+    ):
         """(B, T, FEATURES) -> (B, T) predicted next delta per position.
 
         With ``cache=(keys, values, index)`` (per-layer tuples) this is a
         KV-cached decode step; with ``return_kv=True`` the per-layer
         (k, v) tensors come back alongside the predictions (prefill).
+        ``group`` (paged cache paths only) runs each block member-local
+        over its KV-head slice inside a ``shard_map`` — see
+        :class:`~beholder_tpu.ops.paged_attention.GroupSpec`.
         """
         x = nn.Dense(self.dim, name="embed")(feats.astype(jnp.float32))
         # remat only pays off in the training backward; the decode/prefill
@@ -401,9 +487,17 @@ class TelemetrySequenceModel(nn.Module):
                 name=f"block_{i}",
             )
             if cache is not None:
-                x, kv = block(x, cache=(cache[0][i], cache[1][i], cache[2]))
+                x, kv = block(
+                    x, cache=(cache[0][i], cache[1][i], cache[2]),
+                    group=group,
+                )
                 kvs.append(kv)
             elif return_kv:
+                if group is not None:
+                    raise ValueError(
+                        "group-parallel forwards need a paged cache "
+                        "(prefill stays single-device full-head)"
+                    )
                 x, kv = block(x, return_kv=True)
                 kvs.append(kv)
             else:

@@ -84,6 +84,7 @@ import numpy as np
 
 from beholder_tpu.ops import NUM_STATUSES
 from beholder_tpu.ops.paged_attention import (
+    GroupSpec,
     PagedInfo,
     QuantizedPool,
     pool_dtype_family,
@@ -267,14 +268,18 @@ def slot_cache(state: PagedKVState, slot: int, layer: int):
 
 
 def paged_decode_tick(
-    model: TelemetrySequenceModel, params, state: PagedKVState, feats_t
+    model: TelemetrySequenceModel, params, state: PagedKVState, feats_t,
+    group: GroupSpec | None = None,
 ):
     """One continuous-batching decode step for ALL slots.
 
     ``feats_t`` is (slots, FEATURES); inactive slots run too (their
     writes are dropped, their outputs ignored) — that is what keeps the
     tick a single compiled program. Returns ((slots,) predictions,
-    updated state)."""
+    updated state). ``group`` (inside a ``shard_map`` member — the
+    group engine) runs the forward member-local over this member's
+    KV-head pool slice; the allocator arithmetic here is head-free, so
+    it runs identically (in lockstep) on every member."""
     state = _alloc_for_tick(state)
     num_pages, page = _pool_geometry(state)
     slots = state.page_table.shape[0]
@@ -300,6 +305,7 @@ def paged_decode_tick(
         params,
         feats_t[:, None, :],
         cache=(state.k_pools, state.v_pools, info),
+        group=group,
     )
     state = state._replace(
         k_pools=tuple(k for k, _ in new_kvs),
@@ -317,6 +323,29 @@ def _quantize_tokens(x: jax.Array, values_dtype):
     from beholder_tpu.ops.quant import pool_quantize
 
     return pool_quantize(x, axis=-2, values_dtype=values_dtype)
+
+
+def _slice_chunk_heads(chunk, group: GroupSpec):
+    """This group member's KV-head slice of a FULL-HEAD chunk array
+    (group-parallel decode — :mod:`beholder_tpu.cluster.group`): page
+    chunks travel the wire full-head (``(n, Hkv, ...)``, head axis 1
+    for both values and their ``(n, Hkv, page)`` scales), and each
+    member keeps only its ``Hkv/size`` slice on import/adopt. Only
+    meaningful inside a ``shard_map`` over ``group.axis``. Quantized
+    chunks arrive as ``(values, scales)`` pairs; both slice on axis 1.
+    Head-slicing commutes with the pool's per-(head, token) quantize,
+    so slicing BEFORE :func:`_write_chunks` leaves each member's pool
+    bytes exactly the full pool's slice."""
+
+    def cut(a):
+        hloc = a.shape[1] // group.size
+        m = jax.lax.axis_index(group.axis)
+        return jax.lax.dynamic_slice_in_dim(a, m * hloc, hloc, axis=1)
+
+    if isinstance(chunk, tuple):
+        vals, scales = chunk
+        return (cut(vals), cut(scales))
+    return cut(chunk)
 
 
 def _write_chunks(pool, drop_pages, chunks):
@@ -363,6 +392,7 @@ def paged_admit_batch(
     feats_padded: jax.Array,
     prefix_lens: jax.Array,
     fused: bool = False,
+    group: GroupSpec | None = None,
 ):
     """Admit a WAVE of requests in one prefill: ``feats_padded`` is
     (n, T_max, F) (page-multiple T_max), ``slot_ids``/``prefix_lens``
@@ -406,9 +436,20 @@ def paged_admit_batch(
         preds, kvs = model.apply(
             params, feats_padded,
             cache=(state.k_pools, state.v_pools, info),
+            group=group,
         )
     else:
         preds, kvs = model.apply(params, feats_padded, return_kv=True)
+        if group is not None:
+            # cold group admit: the prefill forward itself runs
+            # replicated full-head on every member (no paged context
+            # to attend, nothing to shard); only the pool SCATTER is
+            # member-local, so slice the kv columns here. The fused
+            # branch above already returns member-local columns.
+            kvs = [
+                (_slice_chunk_heads(k, group), _slice_chunk_heads(v, group))
+                for k, v in kvs
+            ]
     last_pred = preds[
         jnp.arange(n), jnp.clip(prefix_lens - 1, 0, t_max - 1)
     ]
@@ -475,6 +516,7 @@ def paged_admit_with_prefix(
     suffix_len: jax.Array,
     cached_pages: jax.Array,
     fused: bool = False,
+    group: GroupSpec | None = None,
 ):
     """Admit one request whose first ``len(cached_pages) * page`` tokens
     are already resident in the pool (an automatic-prefix-cache hit —
@@ -513,6 +555,17 @@ def paged_admit_with_prefix(
     t_hit = p_hit * page
     p_sfx = s_max // page
 
+    if group is not None and not fused:
+        # the dense oracle gathers the cached context out of the pool,
+        # and a group member's pool holds only its head slice — there
+        # is no replicated full-head gather to run. Warm group admits
+        # therefore ALWAYS take the fused kernel (fused == dense is
+        # already bitwise-pinned repo-wide, and head-sliced fused
+        # attention is pinned by the group engine's own tests).
+        raise ValueError(
+            "group-parallel prefix-hit admission requires fused=True "
+            "(the dense context gather cannot run on a head slice)"
+        )
     if fused:
         # fused path: the suffix chunk attends the cached pages in
         # place (per-row offsets all t_hit; ctx width t_hit + s_max —
@@ -529,6 +582,7 @@ def paged_admit_with_prefix(
         preds, kvs = model.apply(
             params, suffix_feats,
             cache=(state.k_pools, state.v_pools, info),
+            group=group,
         )
     else:
         def dense_context(pool):
@@ -675,6 +729,7 @@ def paged_adopt_chunks(
     chunks_v: tuple,
     n_pages: jax.Array,
     seq_len: jax.Array,
+    group: GroupSpec | None = None,
 ) -> PagedKVState:
     """Shard-aware pool op: admit one request whose prefill KV arrives
     as page chunks from ANOTHER worker (:func:`kv_prefill_chunks` +
@@ -685,9 +740,17 @@ def paged_adopt_chunks(
     have written), and install the slot's page-table row, length, and
     active bit. The dead tail of the static-width chunks (rows past
     ``n_pages``) is masked off exactly like ``paged_admit_batch``'s
-    chunk_alive handling."""
+    chunk_alive handling.
+
+    ``group``: transferred chunks arrive FULL-HEAD from a
+    single-device prefill worker; each group member adopts only its
+    KV-head slice (allocator arithmetic is head-free and runs in
+    lockstep)."""
     num_pages, page = _pool_geometry(state)
     slots, max_pages = state.page_table.shape
+    if group is not None:
+        chunks_k = tuple(_slice_chunk_heads(c, group) for c in chunks_k)
+        chunks_v = tuple(_slice_chunk_heads(c, group) for c in chunks_v)
     p_max = chunks_k[0].shape[0]
     chunk_alive = jnp.arange(p_max) < n_pages
     pages, new_top, ref, failed = _pop_pages(state, chunk_alive)
@@ -753,6 +816,7 @@ def paged_import_pages(
     chunks_v: tuple,
     n_pages: jax.Array,
     refs: jax.Array,
+    group: GroupSpec | None = None,
 ):
     """Adopt migrated pages into THIS pool: pop ``n_pages`` pages off
     the free stack, write the exported chunks VERBATIM (raw values and
@@ -763,8 +827,16 @@ def paged_import_pages(
     Returns (state, dest_ids) — ``dest_ids[i]`` is the pool page now
     holding chunk row ``i`` (garbage past ``n_pages``); the host reads
     it back once to rewrite page tables and cache indexes (migration
-    is an admin operation — the one place a readback is fine)."""
+    is an admin operation — the one place a readback is fine).
+
+    ``group``: migrated chunks travel the wire FULL-HEAD (the export
+    side merges member slices back to full — the wire format is the
+    single-device one, byte for byte); each member imports only its
+    KV-head slice, values and scales alike."""
     num_pages, _ = _pool_geometry(state)
+    if group is not None:
+        chunks_k = tuple(_slice_chunk_heads(c, group) for c in chunks_k)
+        chunks_v = tuple(_slice_chunk_heads(c, group) for c in chunks_v)
     p_max = (
         chunks_k[0][0] if isinstance(chunks_k[0], tuple) else chunks_k[0]
     ).shape[0]
@@ -1082,7 +1154,7 @@ class _RunCarry(NamedTuple):
 
 def _admit_many_carry(
     model, params, state, carry: _RunCarry, slot_ids, feats_padded,
-    prefix_lens, last_statuses,
+    prefix_lens, last_statuses, group: GroupSpec | None = None,
 ):
     """Admit a batch of requests in ONE program (one batched prefill —
     :func:`paged_admit_batch`) and record their prefill predictions +
@@ -1092,7 +1164,8 @@ def _admit_many_carry(
     the admission round is what keeps :meth:`ContinuousBatcher.run`'s
     host traffic per scheduling EVENT, not per request."""
     preds, state = paged_admit_batch(
-        model, params, state, slot_ids, feats_padded, prefix_lens
+        model, params, state, slot_ids, feats_padded, prefix_lens,
+        group=group,
     )
     return state, carry._replace(
         last_pred=carry.last_pred.at[slot_ids].set(
@@ -1107,6 +1180,7 @@ def _admit_many_carry(
 def _admit_cached_carry(
     model, params, state, carry: _RunCarry, slot, suffix_feats,
     suffix_len, cached_pages, last_status, fused=False,
+    group: GroupSpec | None = None,
 ):
     """Admit one prefix-cache HIT (:func:`paged_admit_with_prefix`) and
     record its prediction + status one-hot in the device carry — the
@@ -1118,7 +1192,7 @@ def _admit_cached_carry(
     ``fused_verify`` knob)."""
     pred, state = paged_admit_with_prefix(
         model, params, state, slot, suffix_feats, suffix_len,
-        cached_pages, fused=fused,
+        cached_pages, fused=fused, group=group,
     )
     slot = jnp.asarray(slot, jnp.int32)
     return state, carry._replace(
@@ -1131,7 +1205,7 @@ def _admit_cached_carry(
 
 def _adopt_chunks_carry(
     state, carry: _RunCarry, slot, chunks_k, chunks_v, n_pages, seq_len,
-    pred, last_status,
+    pred, last_status, group: GroupSpec | None = None,
 ):
     """Admit one TRANSFERRED request (:func:`paged_adopt_chunks`) and
     record its prefill prediction + status one-hot in the device
@@ -1140,7 +1214,7 @@ def _adopt_chunks_carry(
     the transfer with the chunks; the same ``astype(float32)`` the
     colocated admit applies keeps the carry seed bitwise identical."""
     state = paged_adopt_chunks(
-        state, slot, chunks_k, chunks_v, n_pages, seq_len
+        state, slot, chunks_k, chunks_v, n_pages, seq_len, group=group
     )
     slot = jnp.asarray(slot, jnp.int32)
     return state, carry._replace(
@@ -1151,7 +1225,10 @@ def _adopt_chunks_carry(
     )
 
 
-def _tick_with_carry(model, params, state, carry: _RunCarry, write_idx):
+def _tick_with_carry(
+    model, params, state, carry: _RunCarry, write_idx,
+    group: GroupSpec | None = None,
+):
     """One decode tick for all slots, feedback on device: append each
     active slot's pending prediction to its forecast row (inactive
     slots pass ``write_idx == cap`` so the write drops), build the tick
@@ -1163,13 +1240,16 @@ def _tick_with_carry(model, params, state, carry: _RunCarry, write_idx):
     feats_t = jnp.concatenate(
         [carry.last_pred[:, None], carry.status_oh], axis=-1
     )
-    preds, state = paged_decode_tick(model, params, state, feats_t)
+    preds, state = paged_decode_tick(model, params, state, feats_t, group)
     return state, carry._replace(
         last_pred=preds.astype(jnp.float32), delta_buf=buf
     )
 
 
-def _tick_chunk(model, params, state, carry: _RunCarry, write_idx, n):
+def _tick_chunk(
+    model, params, state, carry: _RunCarry, write_idx, n,
+    group: GroupSpec | None = None,
+):
     """``n`` decode ticks in ONE program. Between two scheduling events
     (admission, retirement) the per-tick scheduler has no decisions to
     make, so it runs the whole event-free stretch on device —
@@ -1188,7 +1268,9 @@ def _tick_chunk(model, params, state, carry: _RunCarry, write_idx, n):
     def body(c):
         i, state, carry = c
         cur = jnp.where(write_idx >= cap, cap, write_idx + i)
-        state, carry = _tick_with_carry(model, params, state, carry, cur)
+        state, carry = _tick_with_carry(
+            model, params, state, carry, cur, group
+        )
         return i + 1, state, carry
 
     _, state, carry = jax.lax.while_loop(
@@ -1797,6 +1879,39 @@ class ContinuousBatcher:
         ids[: len(pages)] = pages
         alive[: len(pages)] = True
         return jnp.asarray(ids), jnp.asarray(alive)
+
+    @property
+    def transfer_device(self):
+        """The device wire transfers to/from this batcher land on. A
+        single-device batcher is trivially its pool's device; a group
+        batcher (:mod:`beholder_tpu.cluster.group`) overrides this with
+        member 0 — the group's wire endpoint. The migration and fabric
+        paths address the batcher through this property instead of
+        peeking at ``state.seq_lens.devices()``. None degrades to the
+        no-hop local path (uncommitted single-device state)."""
+        try:
+            return next(iter(self.state.seq_lens.devices()))
+        except Exception:  # noqa: BLE001 - uncommitted state
+            return None
+
+    def export_pages(self, page_ids: jax.Array):
+        """Pages ``page_ids`` in WIRE representation — full-head pool
+        chunks exactly as :func:`paged_export_pages` returns them. The
+        group engine overrides this to merge member head-slices back to
+        the full-head wire format, so migration and fabric moves speak
+        one byte-identical dialect regardless of the source's layout."""
+        return paged_export_pages(self.state, page_ids)
+
+    def import_pages(self, chunks_k, chunks_v, n_pages, refs):
+        """Adopt full-head wire chunks into this pool — the
+        :func:`paged_import_pages` half of a move; the group engine
+        overrides this to slice each member's heads on the way in.
+        Returns (new_state, dest_ids); the CALLER assigns
+        ``self.state`` (both sides of a move update state and page
+        tables together)."""
+        return paged_import_pages(
+            self.state, chunks_k, chunks_v, n_pages, refs
+        )
 
     def _evict_cached(self, n_pages: int) -> int:
         """Reclaim up to ``n_pages`` cold cached pages (LRU leaf-first)

@@ -113,13 +113,25 @@ def shape_key(
     kv_heads: int,
     head_dim: int,
     dtype: str,
+    group: int = 1,
 ) -> str:
     """One shape class = one table row. Exact-keyed (no bucketing): a
     near-miss silently tuned for a DIFFERENT shape is worse than the
-    defaults; the fallback direction is explicit instead."""
+    defaults; the fallback direction is explicit instead.
+
+    ``group`` is the GROUP LAYOUT dimension (group-parallel decode —
+    :mod:`beholder_tpu.cluster.group`): a group-of-N member runs the
+    kernel over its ``kv_heads / N`` head slice, a different working
+    set per grid step than the full-head single-device shape, so its
+    measured winners live under their own ``<dtype>:g<N>`` family
+    (``paged_chunk/.../bf16:g2``). ``group=1`` keeps the plain
+    ``<dtype>`` family — the single-device key space is unchanged, and
+    legacy tables (no group segment anywhere) keep resolving as the
+    ``g1`` entries they are."""
+    dtype_seg = dtype if group == 1 else f"{dtype}:g{group}"
     return (
         f"{family}/s{slots}w{width}p{max_pages}x{page}"
-        f"h{kv_heads}d{head_dim}/{dtype}"
+        f"h{kv_heads}d{head_dim}/{dtype_seg}"
     )
 
 
@@ -204,7 +216,7 @@ def flat_entries(obj: dict[str, Any]) -> dict[str, Any]:
     their base keys; v1 flat entries pass through."""
     if "families" in obj:
         return {
-            f"{base}/{family}": entry
+            f"{base}/{_canon_family(family)}": entry
             for family, rows in obj["families"].items()
             for base, entry in rows.items()
         }
@@ -242,11 +254,7 @@ def validate_table(obj: Any) -> None:
         if not isinstance(families, dict):
             raise ValueError("families must be a dict")
         for family, rows in families.items():
-            if family not in FAMILIES:
-                raise ValueError(
-                    f"unknown dtype family {family!r} (want one of "
-                    f"{FAMILIES})"
-                )
+            _canon_family(family)  # raises on unknown family / bad :gN
             if not isinstance(rows, dict):
                 raise ValueError(f"family {family!r} must map to a dict")
             for base, entry in rows.items():
@@ -264,17 +272,42 @@ def validate_table(obj: Any) -> None:
 _FAMILY_ALIASES = {"bfloat16": "bf16"}
 
 
+def _canon_family(family: str) -> str:
+    """Canonical spelling of a dtype family, including its optional
+    group layout suffix: legacy v1 dtype spellings migrate to their
+    family name, and an explicit ``:g1`` suffix collapses onto the
+    plain family — legacy keys (no suffix) ARE the ``g1`` entries, so
+    both spellings must land on the same table row. Raises
+    ``ValueError`` for anything that is not ``<family>[:g<N>]``."""
+    base, sep, grp = family.partition(":g")
+    base = _FAMILY_ALIASES.get(base, base)
+    if base not in FAMILIES:
+        raise ValueError(
+            f"unknown dtype family {family!r} (want one of {FAMILIES},"
+            " optionally suffixed :g<N>)"
+        )
+    if not sep:
+        return base
+    if not grp.isdigit() or int(grp) < 1:
+        raise ValueError(
+            f"family {family!r} has a malformed group suffix (want"
+            " :g<N> with N >= 1)"
+        )
+    return base if int(grp) == 1 else f"{base}:g{int(grp)}"
+
+
 def _split_family(key: str) -> tuple[str, str]:
     """``base/family`` from a full shape key (the dtype family is the
-    last ``/``-segment by :func:`shape_key`'s construction); legacy v1
-    dtype spellings migrate to their family name."""
+    last ``/``-segment by :func:`shape_key`'s construction, optionally
+    carrying a ``:g<N>`` group layout suffix); legacy v1 dtype
+    spellings migrate to their family name and explicit ``:g1``
+    collapses to the plain family."""
     base, _, family = key.rpartition("/")
-    family = _FAMILY_ALIASES.get(family, family)
-    if not base or family not in FAMILIES:
+    if not base:
         raise ValueError(
             f"key {key!r} does not end in a dtype family {FAMILIES}"
         )
-    return base, family
+    return base, _canon_family(family)
 
 
 def save_table(

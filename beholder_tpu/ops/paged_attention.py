@@ -548,6 +548,22 @@ class ChunkPagedInfo(NamedTuple):
     live_pages: int | None = None
 
 
+class GroupSpec(NamedTuple):
+    """Static description of the group-parallel layout a model forward
+    runs under (:mod:`beholder_tpu.cluster.group`): inside a
+    ``shard_map`` over a mesh axis named ``axis`` of size ``size``,
+    each of the ``size`` group members holds a ``1/size`` KV-head
+    slice of every paged pool and computes attention over its own
+    slice, then tile-``all_gather``\\ s the per-member outputs back to
+    the full head dim. Hashable and fully static — it rides the jit
+    closure like :class:`PagedInfo` rides the cache argument, never
+    the trace. ``size=1`` (or passing ``None`` instead of a spec) is
+    the single-device engine, bit for bit."""
+
+    axis: str
+    size: int
+
+
 def _chunk_kernel(
     table_ref, lens_ref, q_ref, kc_ref, vc_ref, kp_ref, vp_ref, ks_ref,
     vs_ref, o_ref, kctx, vctx, kstage, vstage, ksstage, vsstage, sems, *,
@@ -979,6 +995,7 @@ def paged_chunk_attention(
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
     config: dict | None = None,
+    group: int = 1,
 ) -> jax.Array:
     """Fused chunk attention DIRECTLY against the paged pools: each
     slot's ``W``-token query chunk (spec-verify drafts, or a
@@ -1015,6 +1032,13 @@ def paged_chunk_attention(
       override; by default the shape's autotuned entry
       (:mod:`beholder_tpu.ops.autotune`) or its defaults. Block sizes
       are numerics-neutral by construction — they move wall time only.
+    - ``group``: the GROUP LAYOUT this call runs under (group-parallel
+      decode, :mod:`beholder_tpu.cluster.group`): a group-of-N member
+      calls with its ``Hkv/N`` head slice, which is a different shape
+      class than the single-device full-head call even when the padded
+      dims coincide, so its autotune lookup keys onto the
+      ``<dtype>:g<N>`` family. Numerics-neutral — it only selects
+      which measured block sizes serve the call.
 
     Returns (S, H, W, Dh) bf16, BITWISE-identical to running the dense
     cache path over the gathered context (pinned by
@@ -1069,11 +1093,14 @@ def paged_chunk_attention(
         )
     from beholder_tpu.ops import autotune
 
+    if group < 1:
+        raise ValueError(f"group must be >= 1, got {group}")
     dtype = pool_dtype_family(k_pool, quantized=k_scale is not None)
     resolved = autotune.resolve_config(
         autotune.shape_key(
             "paged_chunk", slots=slots, width=w, max_pages=max_pages,
             page=page, kv_heads=hkv, head_dim=dh, dtype=dtype,
+            group=group,
         ),
         explicit=config,
     )

@@ -91,16 +91,60 @@ def place_state(state: Any, mesh: Mesh) -> Any:
 # named mesh axis.
 
 
-def serving_shard_devices(n_workers: int) -> list:
-    """One device per serving worker (decode shards first, then
-    prefill workers), cycling over the available devices — on a forced
-    host-platform CPU mesh the virtual devices, on TPU the chips. More
-    workers than devices co-locate round-robin (capacity arithmetic
-    still shards; the fabric hop degrades to a local copy)."""
+def serving_shard_devices(n_workers: int, group_size: int = 1) -> list:
+    """One device — or one device GROUP — per serving worker (decode
+    shards first, then prefill workers), cycling over the available
+    devices — on a forced host-platform CPU mesh the virtual devices,
+    on TPU the chips. More workers than devices co-locate round-robin
+    (capacity arithmetic still shards; the fabric hop degrades to a
+    local copy).
+
+    ``group_size=1`` (the default) keeps the existing shape: a flat
+    list of single devices. ``group_size=N`` returns a list of
+    N-tuples — worker ``i`` owns the contiguous device block
+    ``[i*N, (i+1)*N)`` (mod the device count), so a group's members
+    are ICI neighbours on real hardware and its per-tick collectives
+    never cross another group's block. The device count must divide by
+    ``group_size`` — a group straddling the wrap-around would alias
+    its own members."""
     if n_workers < 1:
         raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+    if group_size < 1:
+        raise ValueError(f"group_size must be >= 1, got {group_size}")
     devices = jax.devices()
-    return [devices[i % len(devices)] for i in range(n_workers)]
+    if group_size == 1:
+        return [devices[i % len(devices)] for i in range(n_workers)]
+    if len(devices) % group_size:
+        raise ValueError(
+            f"group_size {group_size} does not divide the device "
+            f"count {len(devices)}"
+        )
+    if group_size > len(devices):
+        raise ValueError(
+            f"group_size {group_size} exceeds the device count "
+            f"{len(devices)}"
+        )
+    return [
+        tuple(
+            devices[(i * group_size + m) % len(devices)]
+            for m in range(group_size)
+        )
+        for i in range(n_workers)
+    ]
+
+
+def group_mesh(devices: tuple, axis: str = "tp") -> Mesh:
+    """The ONE-group mesh a group-parallel decode engine shard_maps
+    over: ``(1, N)`` — a degenerate ``dp`` axis of 1 (so the existing
+    dp×tp param specs from :func:`seq_state_shardings` apply verbatim)
+    and the group's members along ``axis``. Each group gets its OWN
+    mesh over its own device tuple; groups never share a collective
+    scope."""
+    devices = tuple(devices)
+    if not devices:
+        raise ValueError("group_mesh needs at least one device")
+    grid = np.array(devices, dtype=object).reshape(1, len(devices))
+    return Mesh(grid, ("dp", axis))
 
 
 # -- megatron tensor parallelism for the transformer ------------------------

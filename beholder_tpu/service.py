@@ -388,6 +388,27 @@ class BeholderService:
         from beholder_tpu.cluster import cluster_from_config
 
         self.cluster = cluster_from_config(config)
+        #: group-parallel decode (``instance.cluster.group.*``; OFF by
+        #: default ⇒ every decode shard stays single-device and
+        #: serving output, handoff wire bytes, and the /metrics
+        #: exposition are byte-identical). The block parses import-
+        #: light into ClusterConfig.group (GroupConfig rejects size<2,
+        #: non-identifier axes and unknown head-partition policies at
+        #: parse time; the KV-head and device-count divide checks live
+        #: where the geometry is known — GroupBatcher and
+        #: serving_shard_devices raise loudly at build). The one
+        #: cross-knob conflict the service CAN see import-light is
+        #: rejected here rather than deep in shard construction:
+        if (
+            self.cluster is not None
+            and self.cluster.group is not None
+            and self.spec is not None
+        ):
+            raise ValueError(
+                "instance.cluster.group and instance.spec are mutually "
+                "exclusive: speculative decoding is a single-device "
+                "lane (GroupBatcher rejects spec) — disable one"
+            )
         #: set by whatever embeds a live ClusterScheduler next to the
         #: consumers. The service only holds the reference: /healthz
         #: gains the ``cluster`` check (degraded while any worker is

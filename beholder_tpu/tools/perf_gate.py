@@ -60,6 +60,11 @@ ratio``                     cross-shard prefix-index hits / lookups   lower
                             promotion recovery wall, both measured
                             interleaved in the same session after
                             bitwise stream asserts
+``group_decode_latency_
+ratio``                     group-of-N per-token decode wall /        higher
+                            single-device wall on the SAME trace,
+                            interleaved in the same session after a
+                            bitwise stream assert — host divides out
 ==========================  ========================================  ======
 
 Absolute figures (telemetry msg/s, flash TFLOP/s, tok/s) are REPORTED
@@ -201,6 +206,19 @@ NOISE_BANDS: dict[str, float] = {
     # degradation = the ratio FALLING (the standby no longer buying
     # recovery time over replay)
     "replica_recovery_ratio": 0.60,
+    # group-of-N / single-device per-token decode wall, both engines
+    # interleaved in the same session on the same trace after bitwise
+    # stream asserts (schema v16) — host drift divides out. On the
+    # CPU host-platform mesh the ratio is structurally ABOVE 1: every
+    # group tick pays tiled all_gather reassembly (params + attention
+    # rows) through the XLA CPU collective emulation, a pure tax with
+    # no ICI to hide it, and run-to-run collective scheduling moves it
+    # like the cluster handoff ratio does. The gate bands drift, not
+    # the tax itself: a regression is the group tick's collective
+    # cost becoming a MULTIPLE of its committed baseline (e.g. an
+    # accidental psum or a per-tick re-gather of frozen params), so
+    # the band matches cluster_decode_latency_ratio's width
+    "group_decode_latency_ratio": 0.50,
 }
 
 #: phase-time percentages compare in absolute percentage POINTS (a
@@ -354,6 +372,13 @@ def _replica_recovery_ratio(artifact: dict) -> float | None:
     return float(value)
 
 
+def _group_decode_ratio(artifact: dict) -> float | None:
+    value = _get(artifact, "group", "group_decode_latency_ratio")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None  # pre-v16 artifact / group scenario not run
+    return float(value)
+
+
 #: (metric, extractor, fail direction): "lower" = degradation is the
 #: current value falling below baseline * (1 - band); "higher" = rising
 #: above baseline * (1 + band)
@@ -400,6 +425,9 @@ RATIO_CHECKS: list[tuple[str, Callable[[dict], float | None], str]] = [
     # replayed/standby-promotion recovery wall: the standby losing its
     # edge over replay shows as the ratio FALLING toward 1.0
     ("replica_recovery_ratio", _replica_recovery_ratio, "lower"),
+    # group/single per-token decode wall: a group-tick regression (the
+    # collective tax becoming a multiple) shows as the ratio RISING
+    ("group_decode_latency_ratio", _group_decode_ratio, "higher"),
 ]
 
 #: absolute figures carried in the verdict for the reader — NEVER gated
@@ -519,6 +547,16 @@ REPORTED_ABSOLUTES: list[tuple[str, Callable[[dict], Any]]] = [
     (
         "fabric_replica_recovery_ms",
         lambda a: _get(a, "fabric", "replica_recovery_ms"),
+    ),
+    # group-decode evidence behind the v16 ratio: absolute per-token
+    # walls are host-dependent, reported only
+    (
+        "group_single_decode_ms_per_tok",
+        lambda a: _get(a, "group", "single_decode_ms_per_tok"),
+    ),
+    (
+        "group_decode_ms_per_tok",
+        lambda a: _get(a, "group", "group_decode_ms_per_tok"),
     ),
 ]
 

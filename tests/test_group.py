@@ -1,0 +1,475 @@
+"""Group-parallel decode (ISSUE 20): the shard_map group engine —
+config parse, the default-OFF byte-identical pin, group-of-2 streams
+bitwise vs the single-device engine across every cache dtype, device
+grouping on the forced 8-device mesh, head-slice handoff adoption,
+fabric cross-shard hits landing on a group shard, whole-group kill →
+bitwise recovery, autotune group family keys, and pool-pristine
+teardown."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from beholder_tpu.cache import PrefixCache
+from beholder_tpu.cluster import (
+    ClusterConfig,
+    FabricConfig,
+    FailoverConfig,
+    GroupConfig,
+    cluster_from_config,
+)
+from beholder_tpu.config import ConfigNode
+from beholder_tpu.metrics import Metrics
+from beholder_tpu.reliability.chaos import (
+    WorkerFault,
+    inject_worker_fault,
+)
+
+pytestmark = [pytest.mark.group, pytest.mark.cluster]
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _mk_model_state():
+    from beholder_tpu.models import TelemetrySequenceModel, init_seq_state
+
+    model = TelemetrySequenceModel(dim=32, heads=2, layers=1)
+    state, _, _ = init_seq_state(jax.random.PRNGKey(0), 24, model=model)
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    return _mk_model_state()
+
+
+def _request(seed, t=9, horizon=6):
+    from beholder_tpu.models.serving import Request
+
+    rng = np.random.default_rng(seed)
+    return Request(
+        np.cumsum(1.0 + rng.normal(0, 0.05, t + 1)),
+        np.full(t + 1, 2),
+        horizon,
+    )
+
+
+BATCHER_KW = dict(
+    num_pages=16, page_size=8, slots=2, max_prefix=16, max_pages_per_seq=4
+)
+
+
+def _mk_single(model, state, **kwargs):
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ContinuousBatcher(model, state.params, **kw)
+
+
+def _mk_group(model, state, n=2, **kwargs):
+    from beholder_tpu.cluster.group import GroupBatcher
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return GroupBatcher(
+        model, state.params, devices=tuple(jax.devices()[:n]), **kw
+    )
+
+
+def _mk_cluster(model, state, cfg, **kwargs):
+    from beholder_tpu.cluster.router import ClusterScheduler
+
+    kw = dict(BATCHER_KW)
+    kw.update(kwargs)
+    return ClusterScheduler(model, state.params, cfg, **kw)
+
+
+def _assert_pool_pristine(batcher):
+    st = jax.device_get(batcher.state)
+    assert int(st.free_top) == batcher.num_pages
+    assert int(np.asarray(st.page_ref).sum()) == 0
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_group_config_parse_and_validation():
+    cfg = cluster_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "group": {"enabled": True, "size": 2},
+                    }
+                }
+            }
+        )
+    )
+    assert cfg.group is not None
+    assert cfg.group.size == 2
+    assert cfg.group.axis == "tp"
+    assert cfg.group.head_partition == "kv_head"
+    # group disabled (or absent) -> None: single-device shards
+    off = cluster_from_config(
+        ConfigNode({"instance": {"cluster": {"enabled": True}}})
+    )
+    assert off.group is None
+    explicit_off = cluster_from_config(
+        ConfigNode(
+            {
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "group": {"enabled": False, "size": 4},
+                    }
+                }
+            }
+        )
+    )
+    assert explicit_off.group is None
+    # loud validation: a group of 1 is a config error, not a no-op
+    with pytest.raises(ValueError):
+        GroupConfig(size=1)
+    with pytest.raises(ValueError):
+        GroupConfig(axis="not an identifier!")
+    with pytest.raises(ValueError):
+        GroupConfig(head_partition="page")
+
+
+def test_group_size_must_divide_kv_heads_and_devices(model_state):
+    from beholder_tpu.parallel.mesh import serving_shard_devices
+
+    model, state = model_state
+    # the dim-32/heads-2 model has 2 KV heads: a group of 3 cannot
+    # partition them (loud at build, where the geometry is known)
+    with pytest.raises(ValueError, match="KV heads"):
+        _mk_group(model, state, n=3)
+    # and a block that does not divide the 8-device mesh is refused
+    # before any group could straddle the wrap-around
+    with pytest.raises(ValueError, match="does not divide"):
+        serving_shard_devices(2, group_size=3)
+
+
+def test_group_rejects_single_device_spec_and_fused_verify(model_state):
+    from beholder_tpu.cluster.group import GroupBatcher
+    from beholder_tpu.spec import SpecConfig
+
+    model, state = model_state
+    with pytest.raises(ValueError, match=">= 2 devices"):
+        GroupBatcher(
+            model, state.params, devices=(jax.devices()[0],), **BATCHER_KW
+        )
+    with pytest.raises(ValueError, match="speculative"):
+        _mk_group(model, state, spec=SpecConfig())
+    with pytest.raises(ValueError, match="fused_verify"):
+        _mk_group(model, state, fused_verify=True)
+
+
+def test_service_refuses_group_plus_spec():
+    from beholder_tpu.mq import InMemoryBroker
+    from beholder_tpu.service import BeholderService
+    from beholder_tpu.storage import MemoryStorage
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        BeholderService(
+            ConfigNode({
+                "keys": {"trello": {"key": "K", "token": "T"}},
+                "instance": {
+                    "cluster": {
+                        "enabled": True,
+                        "group": {"enabled": True},
+                    },
+                    "spec": {"enabled": True},
+                },
+            }),
+            InMemoryBroker(), MemoryStorage(),
+        )
+
+
+# -- device grouping on the forced 8-device mesh ------------------------------
+
+
+def test_serving_shard_devices_grouping():
+    from beholder_tpu.parallel.mesh import serving_shard_devices
+
+    devices = jax.devices()
+    assert len(devices) == 8  # the conftest's forced CPU mesh
+    # degenerate group_size=1 preserves the existing flat shape exactly
+    flat = serving_shard_devices(3)
+    assert flat == serving_shard_devices(3, group_size=1)
+    assert all(not isinstance(d, tuple) for d in flat)
+    # group blocks are contiguous and disjoint while devices last
+    groups = serving_shard_devices(4, group_size=2)
+    assert [g for g in groups] == [
+        (devices[0], devices[1]),
+        (devices[2], devices[3]),
+        (devices[4], devices[5]),
+        (devices[6], devices[7]),
+    ]
+    # oversubscription cycles whole blocks (never straddles)
+    wrapped = serving_shard_devices(5, group_size=2)
+    assert wrapped[4] == (devices[0], devices[1])
+    groups4 = serving_shard_devices(2, group_size=4)
+    assert groups4[0] == tuple(devices[:4])
+    assert groups4[1] == tuple(devices[4:])
+    with pytest.raises(ValueError):
+        serving_shard_devices(1, group_size=16)
+
+
+# -- default OFF: byte-identical serving + exposition ------------------------
+
+
+def test_group_off_serving_and_exposition_byte_identical(model_state):
+    """The knob contract: a group-less cluster after this PR serves
+    bitwise what the single engine serves, registers nothing new, and
+    builds plain single-device shards (no group machinery touched)."""
+    model, state = model_state
+    reqs = [_request(i, horizon=5) for i in range(3)]
+    base = _mk_single(model, state).run(reqs)
+
+    before = Metrics().registry.render()
+    cluster = _mk_cluster(
+        model, state, ClusterConfig(n_decode_workers=2)
+    )
+    got = cluster.run([_request(i, horizon=5) for i in range(3)])
+    after = Metrics().registry.render()
+    assert before == after
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    from beholder_tpu.models.serving import ContinuousBatcher
+
+    for shard in cluster.shards:
+        assert type(shard.batcher) is ContinuousBatcher
+        assert shard.pool.name.startswith("decode-")
+        assert "g" not in shard.pool.name.split("-")[1]
+
+
+# -- the acceptance pin: group == single, bitwise, per dtype ------------------
+
+
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8", "fp8"])
+def test_group_of_two_stream_bitwise_vs_single(model_state, cache_dtype):
+    """Exact-greedy decode through a group of 2 must be
+    ``np.array_equal`` to the single-device engine for every pool
+    dtype: the pool split is by KV head, params reassemble via tiled
+    all_gathers, and no psum touches the numbers anywhere."""
+    model, state = model_state
+    dtype = {"int8": jnp.int8, "fp8": "fp8"}.get(cache_dtype, jnp.bfloat16)
+    reqs = lambda: [_request(i) for i in range(6)]  # noqa: E731
+
+    base = _mk_single(model, state, cache_dtype=dtype).run(reqs())
+    grp = _mk_group(model, state, cache_dtype=dtype)
+    got = grp.run(reqs())
+    for i, (a, b) in enumerate(zip(base, got)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            cache_dtype, i,
+        )
+    # teardown hygiene: every page back on the free stack, lockstep
+    # allocator leaves replicated
+    _assert_pool_pristine(grp)
+
+
+def test_group_warm_admission_bitwise_with_prefix_cache(model_state):
+    """Warm (prefix-hit) admissions on a group shard run the fused
+    head-sliced path; streams must stay bitwise vs the single engine's
+    warm hits, and cache release must return the pool to pristine."""
+    model, state = model_state
+    reqs = lambda: [_request(7), _request(7), _request(8)]  # noqa: E731
+
+    single = _mk_single(model, state, prefix_cache=PrefixCache(8))
+    base_cold = single.run(reqs())
+    base_warm = single.run(reqs())
+
+    grp = _mk_group(model, state, prefix_cache=PrefixCache(8))
+    got_cold = grp.run(reqs())
+    got_warm = grp.run(reqs())
+    assert grp.prefix_cache.hits > 0
+    for a, b in zip(base_cold + base_warm, got_cold + got_warm):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # drop every cache entry -> both pools pristine
+    for b in (single, grp):
+        b._evict_cached(b.num_pages)
+        _assert_pool_pristine(b)
+
+
+# -- cluster integration ------------------------------------------------------
+
+
+def test_group_cluster_colocated_bitwise(model_state):
+    model, state = model_state
+    base = _mk_single(model, state).run([_request(i) for i in range(6)])
+    cluster = _mk_cluster(
+        model, state,
+        ClusterConfig(n_decode_workers=2, group=GroupConfig(size=2)),
+    )
+    assert [s.pool.name for s in cluster.shards] == [
+        "decode-g0", "decode-g1",
+    ]
+    got = cluster.run([_request(i) for i in range(6)])
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for shard in cluster.shards:
+        _assert_pool_pristine(shard.batcher)
+
+
+def test_group_handoff_adopts_per_head_slice_bitwise(model_state):
+    """Disaggregated prefill hands FULL-HEAD chunks to a group shard;
+    each member adopts only its KV-head slice. Streams must be bitwise
+    the single engine's, and the handoff must actually run (the wire
+    format is the single-device dialect — the prefill worker never
+    learns the pool was split)."""
+    model, state = model_state
+    base = _mk_single(model, state).run([_request(i) for i in range(6)])
+    cluster = _mk_cluster(
+        model, state,
+        ClusterConfig(
+            n_decode_workers=2, n_prefill_workers=1,
+            group=GroupConfig(size=2),
+        ),
+    )
+    got = cluster.run([_request(i) for i in range(6)])
+    assert cluster.transfer.transfers > 0
+    for a, b in zip(base, got):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for shard in cluster.shards:
+        _assert_pool_pristine(shard.batcher)
+
+
+def test_fabric_cross_shard_hit_onto_group_shard_bitwise(model_state):
+    """A prefix warm on one group shard admits with a fabric hit on
+    the OTHER group shard: export merges member head-slices to the
+    full-head wire, import re-slices — the borrowing group's stream
+    must equal its local warm hit bitwise."""
+    model, state = model_state
+    warm = [_request(100 + i) for i in range(4)]
+    shifted = warm[1:] + warm[:1]
+    cluster = _mk_cluster(
+        model, state,
+        ClusterConfig(
+            n_decode_workers=2, route_policy="round_robin",
+            fabric=FabricConfig(), group=GroupConfig(size=2),
+        ),
+        prefix_cache_factory=lambda: PrefixCache(8),
+    )
+    cluster.run(warm)            # cold: fills each group's cache
+    local = cluster.run(warm)    # local warm hits: the bitwise oracle
+    fab = cluster.fabric
+    l0, h0 = fab.cross_shard_lookups, fab.cross_shard_hits
+    cross = cluster.run(shifted)
+    assert fab.cross_shard_lookups > l0
+    assert fab.cross_shard_hits > h0
+    assert fab.pages_fetched > 0
+    n = len(warm)
+    for i, stream in enumerate(cross):
+        np.testing.assert_array_equal(
+            np.asarray(stream), np.asarray(local[(i + 1) % n])
+        )
+    assert fab.index.outstanding_pins == 0
+
+
+def test_whole_group_kill_recovers_bitwise(model_state):
+    """Killing a group mid-stream (one fault downs the WHOLE group —
+    members share a fate like chips on one host) must recover every
+    in-flight request onto the surviving group with exact-greedy
+    streams bitwise-identical to an uninterrupted single-engine run,
+    and leave the survivor's pool pristine."""
+    model, state = model_state
+    reqs = [_request(i, horizon=5) for i in range(6)]
+    base = _mk_single(model, state).run(
+        [_request(i, horizon=5) for i in range(6)]
+    )
+    cluster = _mk_cluster(
+        model, state,
+        ClusterConfig(
+            n_decode_workers=2, failover=FailoverConfig(),
+            group=GroupConfig(size=2),
+        ),
+    )
+    inject_worker_fault(
+        cluster, WorkerFault("decode-g1", "kill", after_dispatches=1)
+    )
+    got = cluster.run(reqs)
+    assert cluster.failover.state("decode-g1") == "down"
+    assert cluster.failover.recovered_total > 0
+    for i, (a, b) in enumerate(zip(base, got)):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), i
+    _assert_pool_pristine(cluster.shards[0].batcher)
+    # and the cluster keeps serving on the surviving group
+    again = cluster.run([_request(i, horizon=5) for i in range(6)])
+    for a, b in zip(base, again):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_group_flight_events_carry_member_identities(model_state):
+    """Every tick-chunk dispatch drops one instant per member with
+    ``worker=decode-g0.m<k>`` so merged timelines show which chips the
+    tick spanned; recorder off, nothing records (covered by the
+    default-OFF pin above)."""
+    from beholder_tpu.obs import FlightRecorder
+
+    model, state = model_state
+    fr = FlightRecorder(ring_size=4096)
+    grp = _mk_group(model, state, flight_recorder=fr)
+    grp.run([_request(i) for i in range(3)])
+    events = [e for e in fr.events() if e.get("name") == "group.tick"]
+    assert events, "group ticks must leave member instants when armed"
+    workers = {e["args"]["worker"] for e in events}
+    assert {"decode-g0.m0", "decode-g0.m1"} <= workers
+    assert all(e["args"]["collective"] == "all_gather" for e in events)
+    assert all(e["args"]["members"] == 2 for e in events)
+
+
+def test_group_wire_roundtrip_is_full_head_dialect(model_state):
+    """export_pages from a group merges member slices to the exact
+    bytes the single-device export produces for the same pool content;
+    import back into a group reproduces the stacked slices. Pinned on
+    int8 so values AND scales both ride the wire raw."""
+    model, state = model_state
+    # a prefix cache keeps admitted pages resident after retirement,
+    # giving both pools identical live content to put on the wire
+    single = _mk_single(
+        model, state, cache_dtype=jnp.int8, prefix_cache=PrefixCache(8)
+    )
+    grp = _mk_group(
+        model, state, cache_dtype=jnp.int8, prefix_cache=PrefixCache(8)
+    )
+    reqs = lambda: [_request(3), _request(4)]  # noqa: E731
+    single.run(reqs())
+    grp.run(reqs())
+    ids_s = np.nonzero(np.asarray(jax.device_get(single.state.page_ref)))[0]
+    ids_g = np.nonzero(np.asarray(jax.device_get(grp.state.page_ref)))[0]
+    assert ids_s.size > 0 and np.array_equal(ids_s, ids_g)
+    exp_s = jax.device_get(single.export_pages(jnp.asarray(ids_s, jnp.int32)))
+    exp_g = jax.device_get(grp.export_pages(jnp.asarray(ids_g, jnp.int32)))
+    for a, b in zip(jax.tree.leaves(exp_s), jax.tree.leaves(exp_g)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- autotune family keys ----------------------------------------------------
+
+
+def test_autotune_group_family_keys():
+    from beholder_tpu.ops import autotune
+
+    # group rides the family segment only when > 1 (committed tables
+    # do not churn)
+    kw = dict(
+        slots=2, width=8, max_pages=4, page=8, kv_heads=2, head_dim=16,
+        dtype="bf16",
+    )
+    k1 = autotune.shape_key("paged_chunk", group=1, **kw)
+    k2 = autotune.shape_key("paged_chunk", group=2, **kw)
+    assert ":g" not in k1
+    assert k2.endswith("bf16:g2")
+    assert k2.replace(":g2", "") == k1
+    # legacy keys alias to g1 and canonicalization collapses :g1
+    assert autotune._canon_family("bf16:g1") == "bf16"
+    assert autotune._canon_family("bfloat16:g2") == "bf16:g2"
+    with pytest.raises(ValueError):
+        autotune._canon_family("bf16:g0")
+    with pytest.raises(ValueError):
+        autotune._canon_family("martian:g2")
